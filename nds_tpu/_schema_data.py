@@ -1,0 +1,626 @@
+"""TPC-DS table schema data (spec v3.2.0 facts).
+Column names/types follow the TPC-DS specification; parity target is the
+reference schema registry (reference: nds/nds_schema.py:49-710). Each entry is
+one whitespace-separated line per column: "name dtype [!]" where "!" marks
+non-nullable. Generated from spec facts; formatting is ours.
+"""
+
+SOURCE_TABLES = {
+'customer_address': """\
+    ca_address_sk     int32  !
+    ca_address_id     char(16)  !
+    ca_street_number  char(10)
+    ca_street_name    varchar(60)
+    ca_street_type    char(15)
+    ca_suite_number   char(10)
+    ca_city           varchar(60)
+    ca_county         varchar(30)
+    ca_state          char(2)
+    ca_zip            char(10)
+    ca_country        varchar(20)
+    ca_gmt_offset     decimal(5,2)
+    ca_location_type  char(20)
+""",
+'customer_demographics': """\
+    cd_demo_sk             int32  !
+    cd_gender              char(1)
+    cd_marital_status      char(1)
+    cd_education_status    char(20)
+    cd_purchase_estimate   int32
+    cd_credit_rating       char(10)
+    cd_dep_count           int32
+    cd_dep_employed_count  int32
+    cd_dep_college_count   int32
+""",
+'date_dim': """\
+    d_date_sk            int32  !
+    d_date_id            char(16)  !
+    d_date               date
+    d_month_seq          int32
+    d_week_seq           int32
+    d_quarter_seq        int32
+    d_year               int32
+    d_dow                int32
+    d_moy                int32
+    d_dom                int32
+    d_qoy                int32
+    d_fy_year            int32
+    d_fy_quarter_seq     int32
+    d_fy_week_seq        int32
+    d_day_name           char(9)
+    d_quarter_name       char(6)
+    d_holiday            char(1)
+    d_weekend            char(1)
+    d_following_holiday  char(1)
+    d_first_dom          int32
+    d_last_dom           int32
+    d_same_day_ly        int32
+    d_same_day_lq        int32
+    d_current_day        char(1)
+    d_current_week       char(1)
+    d_current_month      char(1)
+    d_current_quarter    char(1)
+    d_current_year       char(1)
+""",
+'warehouse': """\
+    w_warehouse_sk     int32  !
+    w_warehouse_id     char(16)  !
+    w_warehouse_name   varchar(20)
+    w_warehouse_sq_ft  int32
+    w_street_number    char(10)
+    w_street_name      varchar(60)
+    w_street_type      char(15)
+    w_suite_number     char(10)
+    w_city             varchar(60)
+    w_county           varchar(30)
+    w_state            char(2)
+    w_zip              char(10)
+    w_country          varchar(20)
+    w_gmt_offset       decimal(5,2)
+""",
+'ship_mode': """\
+    sm_ship_mode_sk  int32  !
+    sm_ship_mode_id  char(16)  !
+    sm_type          char(30)
+    sm_code          char(10)
+    sm_carrier       char(20)
+    sm_contract      char(20)
+""",
+'time_dim': """\
+    t_time_sk    int32  !
+    t_time_id    char(16)  !
+    t_time       int32
+    t_hour       int32
+    t_minute     int32
+    t_second     int32
+    t_am_pm      char(2)
+    t_shift      char(20)
+    t_sub_shift  char(20)
+    t_meal_time  char(20)
+""",
+'reason': """\
+    r_reason_sk    int32  !
+    r_reason_id    char(16)  !
+    r_reason_desc  char(100)
+""",
+'income_band': """\
+    ib_income_band_sk  int32  !
+    ib_lower_bound     int32
+    ib_upper_bound     int32
+""",
+'item': """\
+    i_item_sk         int32  !
+    i_item_id         char(16)  !
+    i_rec_start_date  date
+    i_rec_end_date    date
+    i_item_desc       varchar(200)
+    i_current_price   decimal(7,2)
+    i_wholesale_cost  decimal(7,2)
+    i_brand_id        int32
+    i_brand           char(50)
+    i_class_id        int32
+    i_class           char(50)
+    i_category_id     int32
+    i_category        char(50)
+    i_manufact_id     int32
+    i_manufact        char(50)
+    i_size            char(20)
+    i_formulation     char(20)
+    i_color           char(20)
+    i_units           char(10)
+    i_container       char(10)
+    i_manager_id      int32
+    i_product_name    char(50)
+""",
+'store': """\
+    s_store_sk          int32  !
+    s_store_id          char(16)  !
+    s_rec_start_date    date
+    s_rec_end_date      date
+    s_closed_date_sk    int32
+    s_store_name        varchar(50)
+    s_number_employees  int32
+    s_floor_space       int32
+    s_hours             char(20)
+    s_manager           varchar(40)
+    s_market_id         int32
+    s_geography_class   varchar(100)
+    s_market_desc       varchar(100)
+    s_market_manager    varchar(40)
+    s_division_id       int32
+    s_division_name     varchar(50)
+    s_company_id        int32
+    s_company_name      varchar(50)
+    s_street_number     varchar(10)
+    s_street_name       varchar(60)
+    s_street_type       char(15)
+    s_suite_number      char(10)
+    s_city              varchar(60)
+    s_county            varchar(30)
+    s_state             char(2)
+    s_zip               char(10)
+    s_country           varchar(20)
+    s_gmt_offset        decimal(5,2)
+    s_tax_precentage    decimal(5,2)
+""",
+'call_center': """\
+    cc_call_center_sk  int32  !
+    cc_call_center_id  char(16)  !
+    cc_rec_start_date  date
+    cc_rec_end_date    date
+    cc_closed_date_sk  int32
+    cc_open_date_sk    int32
+    cc_name            varchar(50)
+    cc_class           varchar(50)
+    cc_employees       int32
+    cc_sq_ft           int32
+    cc_hours           char(20)
+    cc_manager         varchar(40)
+    cc_mkt_id          int32
+    cc_mkt_class       char(50)
+    cc_mkt_desc        varchar(100)
+    cc_market_manager  varchar(40)
+    cc_division        int32
+    cc_division_name   varchar(50)
+    cc_company         int32
+    cc_company_name    char(50)
+    cc_street_number   char(10)
+    cc_street_name     varchar(60)
+    cc_street_type     char(15)
+    cc_suite_number    char(10)
+    cc_city            varchar(60)
+    cc_county          varchar(30)
+    cc_state           char(2)
+    cc_zip             char(10)
+    cc_country         varchar(20)
+    cc_gmt_offset      decimal(5,2)
+    cc_tax_percentage  decimal(5,2)
+""",
+'customer': """\
+    c_customer_sk           int32  !
+    c_customer_id           char(16)  !
+    c_current_cdemo_sk      int32
+    c_current_hdemo_sk      int32
+    c_current_addr_sk       int32
+    c_first_shipto_date_sk  int32
+    c_first_sales_date_sk   int32
+    c_salutation            char(10)
+    c_first_name            char(20)
+    c_last_name             char(30)
+    c_preferred_cust_flag   char(1)
+    c_birth_day             int32
+    c_birth_month           int32
+    c_birth_year            int32
+    c_birth_country         varchar(20)
+    c_login                 char(13)
+    c_email_address         char(50)
+    c_last_review_date_sk   char(10)
+""",
+'web_site': """\
+    web_site_sk         int32  !
+    web_site_id         char(16)  !
+    web_rec_start_date  date
+    web_rec_end_date    date
+    web_name            varchar(50)
+    web_open_date_sk    int32
+    web_close_date_sk   int32
+    web_class           varchar(50)
+    web_manager         varchar(40)
+    web_mkt_id          int32
+    web_mkt_class       varchar(50)
+    web_mkt_desc        varchar(100)
+    web_market_manager  varchar(40)
+    web_company_id      int32
+    web_company_name    char(50)
+    web_street_number   char(10)
+    web_street_name     varchar(60)
+    web_street_type     char(15)
+    web_suite_number    char(10)
+    web_city            varchar(60)
+    web_county          varchar(30)
+    web_state           char(2)
+    web_zip             char(10)
+    web_country         varchar(20)
+    web_gmt_offset      decimal(5,2)
+    web_tax_percentage  decimal(5,2)
+""",
+'store_returns': """\
+    sr_returned_date_sk    int32
+    sr_return_time_sk      int32
+    sr_item_sk             int32  !
+    sr_customer_sk         int32
+    sr_cdemo_sk            int32
+    sr_hdemo_sk            int32
+    sr_addr_sk             int32
+    sr_store_sk            int32
+    sr_reason_sk           int32
+    sr_ticket_number       int64  !
+    sr_return_quantity     int32
+    sr_return_amt          decimal(7,2)
+    sr_return_tax          decimal(7,2)
+    sr_return_amt_inc_tax  decimal(7,2)
+    sr_fee                 decimal(7,2)
+    sr_return_ship_cost    decimal(7,2)
+    sr_refunded_cash       decimal(7,2)
+    sr_reversed_charge     decimal(7,2)
+    sr_store_credit        decimal(7,2)
+    sr_net_loss            decimal(7,2)
+""",
+'household_demographics': """\
+    hd_demo_sk         int32  !
+    hd_income_band_sk  int32
+    hd_buy_potential   char(15)
+    hd_dep_count       int32
+    hd_vehicle_count   int32
+""",
+'web_page': """\
+    wp_web_page_sk       int32  !
+    wp_web_page_id       char(16)  !
+    wp_rec_start_date    date
+    wp_rec_end_date      date
+    wp_creation_date_sk  int32
+    wp_access_date_sk    int32
+    wp_autogen_flag      char(1)
+    wp_customer_sk       int32
+    wp_url               varchar(100)
+    wp_type              char(50)
+    wp_char_count        int32
+    wp_link_count        int32
+    wp_image_count       int32
+    wp_max_ad_count      int32
+""",
+'promotion': """\
+    p_promo_sk         int32  !
+    p_promo_id         char(16)  !
+    p_start_date_sk    int32
+    p_end_date_sk      int32
+    p_item_sk          int32
+    p_cost             decimal(15,2)
+    p_response_target  int32
+    p_promo_name       char(50)
+    p_channel_dmail    char(1)
+    p_channel_email    char(1)
+    p_channel_catalog  char(1)
+    p_channel_tv       char(1)
+    p_channel_radio    char(1)
+    p_channel_press    char(1)
+    p_channel_event    char(1)
+    p_channel_demo     char(1)
+    p_channel_details  varchar(100)
+    p_purpose          char(15)
+    p_discount_active  char(1)
+""",
+'catalog_page': """\
+    cp_catalog_page_sk      int32  !
+    cp_catalog_page_id      char(16)  !
+    cp_start_date_sk        int32
+    cp_end_date_sk          int32
+    cp_department           varchar(50)
+    cp_catalog_number       int32
+    cp_catalog_page_number  int32
+    cp_description          varchar(100)
+    cp_type                 varchar(100)
+""",
+'inventory': """\
+    inv_date_sk           int32  !
+    inv_item_sk           int32  !
+    inv_warehouse_sk      int32  !
+    inv_quantity_on_hand  int32
+""",
+'catalog_returns': """\
+    cr_returned_date_sk       int32
+    cr_returned_time_sk       int32
+    cr_item_sk                int32  !
+    cr_refunded_customer_sk   int32
+    cr_refunded_cdemo_sk      int32
+    cr_refunded_hdemo_sk      int32
+    cr_refunded_addr_sk       int32
+    cr_returning_customer_sk  int32
+    cr_returning_cdemo_sk     int32
+    cr_returning_hdemo_sk     int32
+    cr_returning_addr_sk      int32
+    cr_call_center_sk         int32
+    cr_catalog_page_sk        int32
+    cr_ship_mode_sk           int32
+    cr_warehouse_sk           int32
+    cr_reason_sk              int32
+    cr_order_number           int32  !
+    cr_return_quantity        int32
+    cr_return_amount          decimal(7,2)
+    cr_return_tax             decimal(7,2)
+    cr_return_amt_inc_tax     decimal(7,2)
+    cr_fee                    decimal(7,2)
+    cr_return_ship_cost       decimal(7,2)
+    cr_refunded_cash          decimal(7,2)
+    cr_reversed_charge        decimal(7,2)
+    cr_store_credit           decimal(7,2)
+    cr_net_loss               decimal(7,2)
+""",
+'web_returns': """\
+    wr_returned_date_sk       int32
+    wr_returned_time_sk       int32
+    wr_item_sk                int32  !
+    wr_refunded_customer_sk   int32
+    wr_refunded_cdemo_sk      int32
+    wr_refunded_hdemo_sk      int32
+    wr_refunded_addr_sk       int32
+    wr_returning_customer_sk  int32
+    wr_returning_cdemo_sk     int32
+    wr_returning_hdemo_sk     int32
+    wr_returning_addr_sk      int32
+    wr_web_page_sk            int32
+    wr_reason_sk              int32
+    wr_order_number           int32  !
+    wr_return_quantity        int32
+    wr_return_amt             decimal(7,2)
+    wr_return_tax             decimal(7,2)
+    wr_return_amt_inc_tax     decimal(7,2)
+    wr_fee                    decimal(7,2)
+    wr_return_ship_cost       decimal(7,2)
+    wr_refunded_cash          decimal(7,2)
+    wr_reversed_charge        decimal(7,2)
+    wr_account_credit         decimal(7,2)
+    wr_net_loss               decimal(7,2)
+""",
+'web_sales': """\
+    ws_sold_date_sk           int32
+    ws_sold_time_sk           int32
+    ws_ship_date_sk           int32
+    ws_item_sk                int32  !
+    ws_bill_customer_sk       int32
+    ws_bill_cdemo_sk          int32
+    ws_bill_hdemo_sk          int32
+    ws_bill_addr_sk           int32
+    ws_ship_customer_sk       int32
+    ws_ship_cdemo_sk          int32
+    ws_ship_hdemo_sk          int32
+    ws_ship_addr_sk           int32
+    ws_web_page_sk            int32
+    ws_web_site_sk            int32
+    ws_ship_mode_sk           int32
+    ws_warehouse_sk           int32
+    ws_promo_sk               int32
+    ws_order_number           int32  !
+    ws_quantity               int32
+    ws_wholesale_cost         decimal(7,2)
+    ws_list_price             decimal(7,2)
+    ws_sales_price            decimal(7,2)
+    ws_ext_discount_amt       decimal(7,2)
+    ws_ext_sales_price        decimal(7,2)
+    ws_ext_wholesale_cost     decimal(7,2)
+    ws_ext_list_price         decimal(7,2)
+    ws_ext_tax                decimal(7,2)
+    ws_coupon_amt             decimal(7,2)
+    ws_ext_ship_cost          decimal(7,2)
+    ws_net_paid               decimal(7,2)
+    ws_net_paid_inc_tax       decimal(7,2)
+    ws_net_paid_inc_ship      decimal(7,2)
+    ws_net_paid_inc_ship_tax  decimal(7,2)
+    ws_net_profit             decimal(7,2)
+""",
+'catalog_sales': """\
+    cs_sold_date_sk           int32
+    cs_sold_time_sk           int32
+    cs_ship_date_sk           int32
+    cs_bill_customer_sk       int32
+    cs_bill_cdemo_sk          int32
+    cs_bill_hdemo_sk          int32
+    cs_bill_addr_sk           int32
+    cs_ship_customer_sk       int32
+    cs_ship_cdemo_sk          int32
+    cs_ship_hdemo_sk          int32
+    cs_ship_addr_sk           int32
+    cs_call_center_sk         int32
+    cs_catalog_page_sk        int32
+    cs_ship_mode_sk           int32
+    cs_warehouse_sk           int32
+    cs_item_sk                int32  !
+    cs_promo_sk               int32
+    cs_order_number           int32  !
+    cs_quantity               int32
+    cs_wholesale_cost         decimal(7,2)
+    cs_list_price             decimal(7,2)
+    cs_sales_price            decimal(7,2)
+    cs_ext_discount_amt       decimal(7,2)
+    cs_ext_sales_price        decimal(7,2)
+    cs_ext_wholesale_cost     decimal(7,2)
+    cs_ext_list_price         decimal(7,2)
+    cs_ext_tax                decimal(7,2)
+    cs_coupon_amt             decimal(7,2)
+    cs_ext_ship_cost          decimal(7,2)
+    cs_net_paid               decimal(7,2)
+    cs_net_paid_inc_tax       decimal(7,2)
+    cs_net_paid_inc_ship      decimal(7,2)
+    cs_net_paid_inc_ship_tax  decimal(7,2)
+    cs_net_profit             decimal(7,2)
+""",
+'store_sales': """\
+    ss_sold_date_sk        int32
+    ss_sold_time_sk        int32
+    ss_item_sk             int32  !
+    ss_customer_sk         int32
+    ss_cdemo_sk            int32
+    ss_hdemo_sk            int32
+    ss_addr_sk             int32
+    ss_store_sk            int32
+    ss_promo_sk            int32
+    ss_ticket_number       int32  !
+    ss_quantity            int32
+    ss_wholesale_cost      decimal(7,2)
+    ss_list_price          decimal(7,2)
+    ss_sales_price         decimal(7,2)
+    ss_ext_discount_amt    decimal(7,2)
+    ss_ext_sales_price     decimal(7,2)
+    ss_ext_wholesale_cost  decimal(7,2)
+    ss_ext_list_price      decimal(7,2)
+    ss_ext_tax             decimal(7,2)
+    ss_coupon_amt          decimal(7,2)
+    ss_net_paid            decimal(7,2)
+    ss_net_paid_inc_tax    decimal(7,2)
+    ss_net_profit          decimal(7,2)
+""",
+}
+
+MAINTENANCE_TABLES = {
+'s_purchase_lineitem': """\
+    plin_purchase_id   int32  !
+    plin_line_number   int32  !
+    plin_item_id       char(16)
+    plin_promotion_id  char(16)
+    plin_quantity      int32
+    plin_sale_price    decimal(7,2)
+    plin_coupon_amt    decimal(7,2)
+    plin_comment       varchar(100)
+""",
+'s_purchase': """\
+    purc_purchase_id    int32  !
+    purc_store_id       char(16)
+    purc_customer_id    char(16)
+    purc_purchase_date  char(10)
+    purc_purchase_time  int32
+    purc_register_id    int32
+    purc_clerk_id       int32
+    purc_comment        char(100)
+""",
+'s_catalog_order': """\
+    cord_order_id          int32  !
+    cord_bill_customer_id  char(16)
+    cord_ship_customer_id  char(16)
+    cord_order_date        char(10)
+    cord_order_time        int32
+    cord_ship_mode_id      char(16)
+    cord_call_center_id    char(16)
+    cord_order_comments    varchar(100)
+""",
+'s_web_order': """\
+    word_order_id          int32  !
+    word_bill_customer_id  char(16)
+    word_ship_customer_id  char(16)
+    word_order_date        char(10)
+    word_order_time        int32
+    word_ship_mode_id      char(16)
+    word_web_site_id       char(16)
+    word_order_comments    char(100)
+""",
+'s_catalog_order_lineitem': """\
+    clin_order_id             int32  !
+    clin_line_number          int32  !
+    clin_item_id              char(16)
+    clin_promotion_id         char(16)
+    clin_quantity             int32
+    clin_sales_price          decimal(7,2)
+    clin_coupon_amt           decimal(7,2)
+    clin_warehouse_id         char(16)
+    clin_ship_date            char(10)
+    clin_catalog_number       int32
+    clin_catalog_page_number  int32
+    clin_ship_cost            decimal(7,2)
+""",
+'s_web_order_lineitem': """\
+    wlin_order_id      int32  !
+    wlin_line_number   int32  !
+    wlin_item_id       char(16)
+    wlin_promotion_id  char(16)
+    wlin_quantity      int32
+    wlin_sales_price   decimal(7,2)
+    wlin_coupon_amt    decimal(7,2)
+    wlin_warehouse_id  char(16)
+    wlin_ship_date     char(10)
+    wlin_ship_cost     decimal(7,2)
+    wlin_web_page_id   char(16)
+""",
+'s_store_returns': """\
+    sret_store_id          char(16)
+    sret_purchase_id       char(16)  !
+    sret_line_number       int32  !
+    sret_item_id           char(16)  !
+    sret_customer_id       char(16)
+    sret_return_date       char(10)
+    sret_return_time       char(10)
+    sret_ticket_number     int64
+    sret_return_qty        int32
+    sret_return_amt        decimal(7,2)
+    sret_return_tax        decimal(7,2)
+    sret_return_fee        decimal(7,2)
+    sret_return_ship_cost  decimal(7,2)
+    sret_refunded_cash     decimal(7,2)
+    sret_reversed_charge   decimal(7,2)
+    sret_store_credit      decimal(7,2)
+    sret_reason_id         char(16)
+""",
+'s_catalog_returns': """\
+    cret_call_center_id      char(16)
+    cret_order_id            int32  !
+    cret_line_number         int32  !
+    cret_item_id             char(16)  !
+    cret_return_customer_id  char(16)
+    cret_refund_customer_id  char(16)
+    cret_return_date         char(10)
+    cret_return_time         char(10)
+    cret_return_qty          int32
+    cret_return_amt          decimal(7,2)
+    cret_return_tax          decimal(7,2)
+    cret_return_fee          decimal(7,2)
+    cret_return_ship_cost    decimal(7,2)
+    cret_refunded_cash       decimal(7,2)
+    cret_reversed_charge     decimal(7,2)
+    cret_merchant_credit     decimal(7,2)
+    cret_reason_id           char(16)
+    cret_shipmode_id         char(16)
+    cret_catalog_page_id     char(16)
+    cret_warehouse_id        char(16)
+""",
+'s_web_returns': """\
+    wret_web_page_id         char(16)
+    wret_order_id            int32  !
+    wret_line_number         int32  !
+    wret_item_id             char(16)  !
+    wret_return_customer_id  char(16)
+    wret_refund_customer_id  char(16)
+    wret_return_date         char(10)
+    wret_return_time         char(10)
+    wret_return_qty          int32
+    wret_return_amt          decimal(7,2)
+    wret_return_tax          decimal(7,2)
+    wret_return_fee          decimal(7,2)
+    wret_return_ship_cost    decimal(7,2)
+    wret_refunded_cash       decimal(7,2)
+    wret_reversed_charge     decimal(7,2)
+    wret_account_credit      decimal(7,2)
+    wret_reason_id           char(16)
+""",
+'s_inventory': """\
+    invn_warehouse_id  char(16)  !
+    invn_item_id       char(16)  !
+    invn_date          char(10)  !
+    invn_qty_on_hand   int32
+""",
+'delete': """\
+    date1  string  !
+    date2  string  !
+""",
+'inventory_delete': """\
+    date1  string  !
+    date2  string  !
+""",
+}

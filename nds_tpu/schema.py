@@ -1,0 +1,112 @@
+"""Schema registry for all TPC-DS source and maintenance tables.
+
+TPU-native counterpart of the reference schema registry
+(reference: nds/nds_schema.py — `get_schemas` :49-562, `get_maintenance_schemas`
+:564-710, decimal/double switch :43-47). Schemas are declared as compact spec
+strings in `_schema_data.py` and materialized here into typed `Schema` objects
+with Arrow conversion for the IO layer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import pyarrow as pa
+
+from . import _schema_data
+from .dtypes import DType, parse_dtype
+
+
+@dataclass(frozen=True)
+class Field:
+    name: str
+    dtype: DType
+    nullable: bool = True
+
+
+@dataclass(frozen=True)
+class Schema:
+    fields: tuple
+    _index: dict = field(default_factory=dict, compare=False, repr=False)
+
+    def __post_init__(self):
+        object.__setattr__(self, "_index", {f.name: i for i, f in enumerate(self.fields)})
+
+    @property
+    def names(self):
+        return [f.name for f in self.fields]
+
+    def __len__(self):
+        return len(self.fields)
+
+    def __iter__(self):
+        return iter(self.fields)
+
+    def __contains__(self, name):
+        return name in self._index
+
+    def field(self, name: str) -> Field:
+        return self.fields[self._index[name]]
+
+    def to_arrow(self, use_decimal: bool = True) -> pa.Schema:
+        return pa.schema(
+            [pa.field(f.name, f.dtype.to_arrow(use_decimal), f.nullable) for f in self.fields]
+        )
+
+
+def _parse_table(spec: str) -> Schema:
+    fields = []
+    for line in spec.strip().splitlines():
+        parts = line.split()
+        if not parts:
+            continue
+        name, dtype = parts[0], parse_dtype(parts[1])
+        nullable = "!" not in parts[2:]
+        fields.append(Field(name, dtype, nullable))
+    return Schema(tuple(fields))
+
+
+def _float_mode(schema: Schema) -> Schema:
+    """decimal -> float64, matching the reference's use_decimal=False mode."""
+    return Schema(
+        tuple(
+            Field(f.name, DType("float64") if f.dtype.is_decimal else f.dtype, f.nullable)
+            for f in schema.fields
+        )
+    )
+
+
+_SOURCE = {name: _parse_table(spec) for name, spec in _schema_data.SOURCE_TABLES.items()}
+_MAINT = {name: _parse_table(spec) for name, spec in _schema_data.MAINTENANCE_TABLES.items()}
+
+
+def get_schemas(use_decimal: bool = True) -> dict:
+    """All 24 source-table schemas. use_decimal=False maps decimal->float64."""
+    if use_decimal:
+        return dict(_SOURCE)
+    return {name: _float_mode(s) for name, s in _SOURCE.items()}
+
+
+def get_maintenance_schemas(use_decimal: bool = True) -> dict:
+    """The 12 refresh/staging table schemas used by Data Maintenance."""
+    if use_decimal:
+        return dict(_MAINT)
+    return {name: _float_mode(s) for name, s in _MAINT.items()}
+
+
+# Fact tables partitioned on write, and their partition column
+# (parity: nds/nds_transcode.py:45-53 TABLE_PARTITIONING).
+TABLE_PARTITIONING = {
+    "catalog_sales": "cs_sold_date_sk",
+    "catalog_returns": "cr_returned_date_sk",
+    "inventory": "inv_date_sk",
+    "store_sales": "ss_sold_date_sk",
+    "store_returns": "sr_returned_date_sk",
+    "web_sales": "ws_sold_date_sk",
+    "web_returns": "wr_returned_date_sk",
+}
+
+
+if __name__ == "__main__":
+    for tname, schema in {**get_schemas(), **get_maintenance_schemas()}.items():
+        print(f"{tname}: {len(schema)} columns")

@@ -1,0 +1,75 @@
+import pyarrow as pa
+import pytest
+
+from nds_tpu import schema
+from nds_tpu.dtypes import parse_dtype, DType, common_numeric, FLOAT64, INT64
+
+
+def test_source_table_count_and_columns():
+    s = schema.get_schemas()
+    assert len(s) == 24
+    assert len(s["store_sales"]) == 23
+    assert len(s["date_dim"]) == 28
+    assert len(s["catalog_sales"]) == 34
+    assert len(s["web_sales"]) == 34
+    assert len(s["item"]) == 22
+    # sr_ticket_number is int64 (reference: nds/nds_schema.py:322-325)
+    assert s["store_returns"].field("sr_ticket_number").dtype.kind == "int64"
+    assert not s["store_returns"].field("sr_ticket_number").nullable
+
+
+def test_maintenance_table_count():
+    m = schema.get_maintenance_schemas()
+    assert len(m) == 12
+    assert "s_purchase_lineitem" in m and "delete" in m and "inventory_delete" in m
+
+
+def test_decimal_float_switch():
+    dec = schema.get_schemas(use_decimal=True)
+    flt = schema.get_schemas(use_decimal=False)
+    f_dec = dec["store_sales"].field("ss_list_price")
+    f_flt = flt["store_sales"].field("ss_list_price")
+    assert f_dec.dtype == DType("decimal", 7, 2)
+    assert f_flt.dtype.kind == "float64"
+
+
+def test_arrow_conversion():
+    s = schema.get_schemas()["customer_address"]
+    arrow = s.to_arrow()
+    assert arrow.field("ca_address_sk").type == pa.int32()
+    assert arrow.field("ca_gmt_offset").type == pa.decimal128(5, 2)
+    assert arrow.field("ca_city").type == pa.string()
+    assert not arrow.field("ca_address_sk").nullable
+    arrow_f = s.to_arrow(use_decimal=False)
+    assert arrow_f.field("ca_gmt_offset").type == pa.float64()
+
+
+def test_dtype_parse_roundtrip():
+    for s in ["int32", "int64", "float64", "date", "string", "decimal(7,2)", "char(16)", "varchar(60)"]:
+        assert str(parse_dtype(s)) == s
+    with pytest.raises(ValueError):
+        parse_dtype("int16")
+
+
+def test_device_mapping():
+    import numpy as np
+
+    assert parse_dtype("decimal(7,2)").device_np_dtype() == np.int64
+    assert parse_dtype("decimal(7,2)").device_np_dtype(use_decimal=False) == np.float64
+    assert parse_dtype("char(10)").device_np_dtype() == np.int32
+    assert parse_dtype("date").device_np_dtype() == np.int32
+
+
+def test_numeric_promotion():
+    d72 = parse_dtype("decimal(7,2)")
+    d152 = parse_dtype("decimal(15,2)")
+    assert common_numeric(d72, FLOAT64) == FLOAT64
+    assert common_numeric(d72, d152) == DType("decimal", 16, 2)
+    assert common_numeric(INT64, parse_dtype("int32")) == INT64
+
+
+def test_partitioning_map():
+    assert set(schema.TABLE_PARTITIONING) == {
+        "catalog_sales", "catalog_returns", "inventory", "store_sales",
+        "store_returns", "web_sales", "web_returns",
+    }
