@@ -1,0 +1,139 @@
+"""Data-generation CLI.
+
+Counterpart of the reference's generator driver (reference:
+nds/nds_gen_data.py — generate_data_local :183-244, generate_data_hdfs
+:130-180, merge/move helpers :85-127). Local mode fans out one ndsgen
+process per chunk; cluster mode fans chunks across hosts over ssh onto a
+shared filesystem — replacing the reference's Hadoop-MapReduce wrapper
+(reference: nds/tpcds-gen/.../GenTable.java:188-209) with direct process
+fan-out, which is the natural shape on TPU pod host VMs.
+
+Output layout (identical to the reference's):
+  data_dir/<table>/<table>_<child>_<parallel>.dat
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+from nds_tpu import check
+from nds_tpu.schema import get_schemas, get_maintenance_schemas
+
+SOURCE_TABLE_NAMES = sorted(get_schemas().keys())
+MAINTENANCE_TABLE_NAMES = sorted(get_maintenance_schemas().keys())
+
+
+def _chunk_cmds(binary, args, children):
+    cmds = []
+    for i in children:
+        cmd = [binary, "-scale", str(args.scale), "-dir", args.data_dir,
+               "-parallel", str(args.parallel), "-child", str(i), "-seed", str(args.seed)]
+        if args.update:
+            cmd += ["-update", str(args.update)]
+        if args.table:
+            cmd += ["-table", args.table]
+        cmds.append(cmd)
+    return cmds
+
+
+def _layout_tables(args, children):
+    """Move chunk files into per-table subdirectories."""
+    names = MAINTENANCE_TABLE_NAMES if args.update else SOURCE_TABLE_NAMES
+    for table in names:
+        table_dir = os.path.join(args.data_dir, table)
+        os.makedirs(table_dir, exist_ok=True)
+        for i in children:
+            src = os.path.join(args.data_dir, f"{table}_{i}_{args.parallel}.dat")
+            if os.path.exists(src):
+                shutil.move(src, table_dir)
+
+
+def generate_data_local(args, children):
+    binary = check.check_build()
+    os.makedirs(args.data_dir, exist_ok=True)
+    if check.get_dir_size(args.data_dir) > 0:
+        if not args.overwrite_output:
+            raise Exception(
+                f"There's already data in {args.data_dir}. Use '--overwrite_output' to overwrite.")
+        # Wipe stale content unless this is an incremental --range fill,
+        # so reruns with a different --parallel can't mix chunk sets.
+        if not args.range:
+            for entry in os.listdir(args.data_dir):
+                path = os.path.join(args.data_dir, entry)
+                shutil.rmtree(path) if os.path.isdir(path) else os.remove(path)
+    procs = [subprocess.Popen(cmd) for cmd in _chunk_cmds(binary, args, children)]
+    for p in procs:
+        p.wait()
+        if p.returncode != 0:
+            raise Exception(f"ndsgen failed with return code {p.returncode}")
+    _layout_tables(args, children)
+    subprocess.run(["du", "-h", "-d1", args.data_dir])
+
+
+def generate_data_cluster(args, children):
+    """Fan chunks across hosts over ssh; every host writes to the shared
+    data_dir (NFS/GCS-fuse). Hosts file: one hostname per line."""
+    binary = check.check_build()
+    with open(args.hosts) as f:
+        hosts = [h.strip() for h in f if h.strip() and not h.strip().startswith("#")]
+    if not hosts:
+        raise Exception(f"no hosts in {args.hosts}")
+    os.makedirs(args.data_dir, exist_ok=True)
+    procs = []
+    for n, cmd in enumerate(_chunk_cmds(binary, args, children)):
+        host = hosts[n % len(hosts)]
+        if host in ("localhost", "127.0.0.1"):
+            procs.append(subprocess.Popen(cmd))
+        else:
+            procs.append(subprocess.Popen(["ssh", host] + cmd))
+    for p in procs:
+        p.wait()
+        if p.returncode != 0:
+            raise Exception(f"remote ndsgen failed with return code {p.returncode}")
+    _layout_tables(args, children)
+
+
+def generate_data(args):
+    check.check_version()
+    if args.table:
+        valid = set(MAINTENANCE_TABLE_NAMES if args.update else SOURCE_TABLE_NAMES)
+        if args.table not in valid:
+            raise Exception(f"unknown table {args.table!r}; expected one of {sorted(valid)}")
+    range_start, range_end = 1, args.parallel
+    if args.range:
+        range_start, range_end = check.valid_range(args.range, args.parallel)
+    children = range(range_start, range_end + 1)
+    if args.type == "local":
+        generate_data_local(args, children)
+    else:
+        generate_data_cluster(args, children)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="Generate TPC-DS-shaped raw data (pipe-delimited)")
+    parser.add_argument("type", choices=["local", "cluster"], nargs="?", default="local",
+                        help="generate on this host or fan out across a host list")
+    parser.add_argument("--scale", type=check.scale_of, required=True,
+                        help="volume of data to generate in GB (fractional allowed for smoke tests)")
+    parser.add_argument("--parallel", type=check.parallel_value_type, default=2,
+                        help="generate data in <n> chunks")
+    parser.add_argument("--data_dir", required=True, help="target directory for generated data")
+    parser.add_argument("--range", help="generate only chunks 'start,end' of the parallel set")
+    parser.add_argument("--update", type=int, help="generate refresh set <n> (maintenance/throughput)")
+    parser.add_argument("--table", help="generate only this table")
+    parser.add_argument("--seed", type=int, default=19620718, help="RNG seed")
+    parser.add_argument("--overwrite_output", action="store_true",
+                        help="overwrite existing data in data_dir")
+    parser.add_argument("--hosts", default="hosts.txt", help="hosts file for cluster mode")
+    args = parser.parse_args(argv)
+    generate_data(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""Self-building native generator.
+
+The reference requires a manual `make` against an externally-downloaded
+toolkit (reference: nds/tpcds-gen/Makefile:14-22, checked by nds/check.py:47-66);
+we instead vendor the generator source and compile it on first use, caching
+the binary next to the sources.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+BINARY = os.path.join(NATIVE_DIR, "ndsgen")
+_SOURCES = ["ndsgen.cpp"]
+_HEADERS = ["ndsgen.hpp", "vocab.hpp", "rowcounts.hpp", "dims.hpp", "facts.hpp", "refresh.hpp"]
+
+
+def _stale() -> bool:
+    if not os.path.exists(BINARY):
+        return True
+    bin_mtime = os.path.getmtime(BINARY)
+    for f in _SOURCES + _HEADERS:
+        if os.path.getmtime(os.path.join(NATIVE_DIR, f)) > bin_mtime:
+            return True
+    return False
+
+
+def ensure_built() -> str:
+    """Compile ndsgen if missing or out of date; returns the binary path."""
+    if _stale():
+        cmd = ["g++", "-O2", "-std=c++17", "-o", BINARY] + [
+            os.path.join(NATIVE_DIR, s) for s in _SOURCES
+        ]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(f"ndsgen build failed:\n{proc.stderr}")
+    return BINARY

@@ -1,0 +1,164 @@
+import io
+import os
+import subprocess
+
+import pyarrow as pa
+import pyarrow.compute as pc
+import pyarrow.csv as pv
+import pytest
+
+from nds_tpu.datagen.build import ensure_built
+from nds_tpu.schema import get_schemas, get_maintenance_schemas
+
+SCALE = "0.002"
+
+
+@pytest.fixture(scope="module")
+def datadir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("raw")
+    from nds_tpu.cli.gen_data import main
+
+    main(["local", "--scale", SCALE, "--parallel", "2", "--data_dir", str(d)])
+    return d
+
+
+@pytest.fixture(scope="module")
+def updatedir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("raw_update")
+    from nds_tpu.cli.gen_data import main
+
+    main(["local", "--scale", SCALE, "--parallel", "2", "--data_dir", str(d), "--update", "1"])
+    return d
+
+
+def read_table(data_dir, table, schema):
+    """Read a generated .dat table through its Arrow schema (the exact path
+    the transcode phase uses)."""
+    names = schema.names + ["_trailing"]
+    types = {f.name: f.dtype.to_arrow() for f in schema}
+    tables = []
+    table_dir = os.path.join(data_dir, table)
+    for fname in sorted(os.listdir(table_dir)):
+        with open(os.path.join(table_dir, fname), "rb") as f:
+            data = f.read()
+        if not data:
+            continue
+        tables.append(pv.read_csv(
+            io.BytesIO(data),
+            read_options=pv.ReadOptions(column_names=names),
+            parse_options=pv.ParseOptions(delimiter="|"),
+            convert_options=pv.ConvertOptions(column_types=types, strings_can_be_null=True),
+        ).drop_columns(["_trailing"]))
+    return pa.concat_tables(tables)
+
+
+def test_layout(datadir):
+    for table in get_schemas():
+        assert os.path.isdir(datadir / table), f"missing dir for {table}"
+
+
+def test_all_tables_parse_with_schema(datadir):
+    for table, schema in get_schemas().items():
+        t = read_table(datadir, table, schema)
+        assert t.num_rows > 0, table
+
+
+def test_fixed_cross_product_tables(datadir):
+    schemas = get_schemas()
+    hd = read_table(datadir, "household_demographics", schemas["household_demographics"])
+    assert hd.num_rows == 7200
+    assert len(pc.unique(hd.column("hd_demo_sk"))) == 7200
+    ib = read_table(datadir, "income_band", schemas["income_band"])
+    assert ib.num_rows == 20
+
+
+def test_date_dim_calendar(datadir):
+    dd = read_table(datadir, "date_dim", get_schemas()["date_dim"])
+    assert dd.num_rows == 73049
+    import datetime
+
+    row = dd.slice(0, 1).to_pylist()[0]
+    assert row["d_date_sk"] == 2415022
+    assert row["d_date"] == datetime.date(1900, 1, 2)
+    # 2000-01-01 was a Saturday
+    mask = pc.equal(dd.column("d_date_sk"), 2451545)
+    y2k = dd.filter(mask).to_pylist()[0]
+    assert y2k["d_year"] == 2000 and y2k["d_day_name"].strip() == "Saturday"
+    assert y2k["d_quarter_name"].strip() == "2000Q1"
+
+
+def test_referential_integrity(datadir):
+    schemas = get_schemas()
+    ss = read_table(datadir, "store_sales", schemas["store_sales"])
+    item = read_table(datadir, "item", schemas["item"])
+    store = read_table(datadir, "store", schemas["store"])
+    item_sks = set(item.column("i_item_sk").to_pylist())
+    assert set(x for x in ss.column("ss_item_sk").to_pylist()) <= item_sks
+    store_sks = set(store.column("s_store_sk").to_pylist())
+    assert set(x for x in ss.column("ss_store_sk").to_pylist() if x is not None) <= store_sks
+
+
+def test_returns_reference_sales(datadir):
+    schemas = get_schemas()
+    ss = read_table(datadir, "store_sales", schemas["store_sales"])
+    sr = read_table(datadir, "store_returns", schemas["store_returns"])
+    # every return (ticket, item) must exist in sales
+    sales_keys = set(zip(ss.column("ss_ticket_number").to_pylist(),
+                         ss.column("ss_item_sk").to_pylist()))
+    ret_keys = set(zip(sr.column("sr_ticket_number").to_pylist(),
+                       sr.column("sr_item_sk").to_pylist()))
+    assert ret_keys <= sales_keys
+    # ~10% of lines return
+    assert 0.02 < sr.num_rows / ss.num_rows < 0.25
+
+
+def test_price_arithmetic(datadir):
+    ss = read_table(datadir, "store_sales", get_schemas()["store_sales"])
+    row = ss.slice(0, 200).to_pylist()
+    for r in row:
+        if r["ss_quantity"] is None:
+            continue
+        assert r["ss_ext_sales_price"] == r["ss_sales_price"] * r["ss_quantity"]
+        assert r["ss_net_paid"] == r["ss_ext_sales_price"] - r["ss_coupon_amt"]
+        assert r["ss_net_profit"] == r["ss_net_paid"] - r["ss_ext_wholesale_cost"]
+
+
+def test_chunks_are_deterministic(tmp_path):
+    binary = ensure_built()
+    out1, out2 = tmp_path / "a", tmp_path / "b"
+    out1.mkdir(), out2.mkdir()
+    for out in (out1, out2):
+        subprocess.run([binary, "-scale", "0.002", "-dir", str(out), "-table", "web_sales"],
+                       check=True)
+    f = "web_sales_1_1.dat"
+    assert (out1 / f).read_bytes() == (out2 / f).read_bytes()
+
+
+def test_update_refresh_sets(updatedir):
+    schemas = get_maintenance_schemas()
+    for table in schemas:
+        assert os.path.isdir(updatedir / table), f"missing refresh table {table}"
+    sp = read_table(updatedir, "s_purchase", schemas["s_purchase"])
+    spl = read_table(updatedir, "s_purchase_lineitem", schemas["s_purchase_lineitem"])
+    assert sp.num_rows > 0
+    # every lineitem belongs to a purchase
+    assert set(spl.column("plin_purchase_id").to_pylist()) <= set(
+        sp.column("purc_purchase_id").to_pylist())
+    dele = read_table(updatedir, "delete", schemas["delete"])
+    assert dele.num_rows == 3  # 3 DATE1/DATE2 tuples per refresh set
+
+
+def test_range_generation(tmp_path):
+    from nds_tpu.cli.gen_data import main
+
+    d1 = tmp_path / "full"
+    main(["local", "--scale", SCALE, "--parallel", "4", "--data_dir", str(d1)])
+    d2 = tmp_path / "ranged"
+    main(["local", "--scale", SCALE, "--parallel", "4", "--range", "1,2", "--data_dir", str(d2)])
+    main(["local", "--scale", SCALE, "--parallel", "4", "--range", "3,4", "--data_dir", str(d2),
+          "--overwrite_output"])
+    a = sorted(os.listdir(d1 / "catalog_sales"))
+    b = sorted(os.listdir(d2 / "catalog_sales"))
+    assert a == b
+    for f in a:
+        assert (d1 / "catalog_sales" / f).read_bytes() == (d2 / "catalog_sales" / f).read_bytes()
