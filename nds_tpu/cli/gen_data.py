@@ -54,24 +54,37 @@ def _layout_tables(args, children):
                 shutil.move(src, table_dir)
 
 
-def generate_data_local(args, children):
-    binary = check.check_build()
+def _guard_output_dir(args):
+    """Refuse to mix chunk sets: non-empty target needs --overwrite_output,
+    and a full (non --range) rerun wipes stale content first."""
     os.makedirs(args.data_dir, exist_ok=True)
     if check.get_dir_size(args.data_dir) > 0:
         if not args.overwrite_output:
             raise Exception(
                 f"There's already data in {args.data_dir}. Use '--overwrite_output' to overwrite.")
-        # Wipe stale content unless this is an incremental --range fill,
-        # so reruns with a different --parallel can't mix chunk sets.
         if not args.range:
             for entry in os.listdir(args.data_dir):
                 path = os.path.join(args.data_dir, entry)
                 shutil.rmtree(path) if os.path.isdir(path) else os.remove(path)
-    procs = [subprocess.Popen(cmd) for cmd in _chunk_cmds(binary, args, children)]
+
+
+def _wait_all(procs, what):
+    """Wait for every child before raising, so a failed chunk can't leave
+    siblings racing a subsequent --overwrite_output rerun."""
+    failed = []
     for p in procs:
         p.wait()
         if p.returncode != 0:
-            raise Exception(f"ndsgen failed with return code {p.returncode}")
+            failed.append(p.returncode)
+    if failed:
+        raise Exception(f"{what} failed with return code(s) {failed}")
+
+
+def generate_data_local(args, children):
+    binary = check.check_build()
+    _guard_output_dir(args)
+    procs = [subprocess.Popen(cmd) for cmd in _chunk_cmds(binary, args, children)]
+    _wait_all(procs, "ndsgen")
     _layout_tables(args, children)
     subprocess.run(["du", "-h", "-d1", args.data_dir])
 
@@ -84,7 +97,7 @@ def generate_data_cluster(args, children):
         hosts = [h.strip() for h in f if h.strip() and not h.strip().startswith("#")]
     if not hosts:
         raise Exception(f"no hosts in {args.hosts}")
-    os.makedirs(args.data_dir, exist_ok=True)
+    _guard_output_dir(args)
     procs = []
     for n, cmd in enumerate(_chunk_cmds(binary, args, children)):
         host = hosts[n % len(hosts)]
@@ -92,10 +105,7 @@ def generate_data_cluster(args, children):
             procs.append(subprocess.Popen(cmd))
         else:
             procs.append(subprocess.Popen(["ssh", host] + cmd))
-    for p in procs:
-        p.wait()
-        if p.returncode != 0:
-            raise Exception(f"remote ndsgen failed with return code {p.returncode}")
+    _wait_all(procs, "remote ndsgen")
     _layout_tables(args, children)
 
 

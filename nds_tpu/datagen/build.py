@@ -28,12 +28,18 @@ def _stale() -> bool:
 
 
 def ensure_built() -> str:
-    """Compile ndsgen if missing or out of date; returns the binary path."""
+    """Compile ndsgen if missing or out of date; returns the binary path.
+
+    Compiles to a process-unique temp path and os.replace()s it in, so
+    concurrent builders can't truncate a binary another process is executing.
+    """
     if _stale():
-        cmd = ["g++", "-O2", "-std=c++17", "-o", BINARY] + [
+        tmp = f"{BINARY}.build.{os.getpid()}"
+        cmd = ["g++", "-O2", "-std=c++17", "-o", tmp] + [
             os.path.join(NATIVE_DIR, s) for s in _SOURCES
         ]
         proc = subprocess.run(cmd, capture_output=True, text=True)
         if proc.returncode != 0:
             raise RuntimeError(f"ndsgen build failed:\n{proc.stderr}")
+        os.replace(tmp, BINARY)
     return BINARY

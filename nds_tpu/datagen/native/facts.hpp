@@ -25,7 +25,7 @@ inline LineVals compute_line(const Ctx& ctx, uint64_t table, int64_t order, int 
                              bool with_ship) {
   Rng r(ctx.seed, table, order, line + 1);
   LineVals v;
-  v.item_sk = r.range(100, 1, ctx.n_item);
+  v.item_sk = r.range(100, 1, (ctx.n_item + 1) / 2) * 2 - 1;  // odd = current SCD row
   v.has_promo = r.chance(101, 30);
   v.promo_sk = r.range(101, 1, ctx.n_promotion, 1);
   v.quantity = r.range(102, 1, 100);
@@ -62,6 +62,14 @@ inline int lines_of(const Ctx& ctx, uint64_t table, int64_t order, const Channel
 inline void fk(RowWriter& w, const Rng& r, uint32_t col, int64_t hi) {
   if (r.chance(col, 96))
     w.i64(r.range(col, 1, hi, 1));
+  else
+    w.null_field();
+}
+
+// nullable FK into an SCD-2 dim: only odd (current) sks are referenced
+inline void fk_odd(RowWriter& w, const Rng& r, uint32_t col, int64_t hi) {
+  if (r.chance(col, 96))
+    w.i64(r.range(col, 1, (hi + 1) / 2, 1) * 2 - 1);
   else
     w.null_field();
 }
@@ -357,7 +365,7 @@ inline void gen_web_sales_order(RowWriter& w, const Ctx& ctx, int64_t order) {
     w.i64(o.ship_cdemo);
     w.i64(o.ship_hdemo);
     w.i64(o.ship_addr);
-    fk(w, r, 121, ctx.n_web_page);
+    fk_odd(w, r, 121, ctx.n_web_page);
     w.i64(o.web_site);
     w.i64(o.ship_mode);
     w.i64(r.range(122, 1, ctx.n_warehouse));
@@ -412,7 +420,7 @@ inline void gen_web_returns_order(RowWriter& w, const Ctx& ctx, int64_t order) {
     w.i64(o.ship_cdemo);
     w.i64(o.ship_hdemo);
     w.i64(o.ship_addr);
-    fk(w, r, 8, ctx.n_web_page);
+    fk_odd(w, r, 8, ctx.n_web_page);
     fk(w, r, 10, ctx.n_reason);
     w.i64(order + 1);
     w.i64(rq);

@@ -153,10 +153,31 @@ inline void gen_s_web_order_lineitem(RowWriter& w, const Ctx& ctx, int update, i
 // ---- return staging -------------------------------------------------------
 // Each update returns lines from a pseudo-random sample of BASE orders.
 
+// Format-preserving permutation of [0, n): 4-round Feistel on the smallest
+// even bit-width covering n, cycle-walked back into range. Collision-free by
+// construction, so two sample indices can never map to the same base order
+// (a plain hash-mod here emitted byte-identical duplicate return rows).
+inline int64_t permute_into(uint64_t key, uint64_t j, uint64_t n) {
+  int k = 2;
+  while ((uint64_t(1) << k) < n) k += 2;
+  const int h = k / 2;
+  const uint64_t half_mask = (uint64_t(1) << h) - 1;
+  uint64_t x = j;
+  do {
+    for (int rd = 0; rd < 4; ++rd) {
+      const uint64_t L = x >> h, R = x & half_mask;
+      const uint64_t f = mix64(R ^ key ^ (uint64_t(rd) << 56)) & half_mask;
+      x = (R << h) | (L ^ f);
+    }
+  } while (x >= n);
+  return static_cast<int64_t>(x);
+}
+
 inline int64_t sampled_base_order(const Ctx& ctx, const Channel& ch, uint64_t table,
                                   int update, int64_t j) {
-  return static_cast<int64_t>(mix64(mix64(ctx.seed ^ (table << 40) ^ update) ^ j) %
-                              static_cast<uint64_t>(channel_orders(ch, ctx.sf)));
+  const uint64_t key = mix64(ctx.seed ^ (table << 40) ^ update);
+  return permute_into(key, static_cast<uint64_t>(j),
+                      static_cast<uint64_t>(channel_orders(ch, ctx.sf)));
 }
 
 inline void gen_s_store_returns(RowWriter& w, const Ctx& ctx, int update, int64_t j) {

@@ -40,6 +40,10 @@ class DType:
 
     # ---- classification -------------------------------------------------
     @property
+    def is_bool(self) -> bool:
+        return self.kind == "bool"
+
+    @property
     def is_string(self) -> bool:
         return self.kind in ("char", "varchar", "string")
 
@@ -77,6 +81,8 @@ class DType:
             return pa.int64()
         if k == "float64":
             return pa.float64()
+        if k == "bool":
+            return pa.bool_()
         if k == "decimal":
             return pa.decimal128(self.a, self.b) if use_decimal else pa.float64()
         if k == "date":
@@ -94,6 +100,8 @@ class DType:
             return np.int64
         if k == "float64":
             return np.float64
+        if k == "bool":
+            return np.bool_
         if k == "decimal":
             return np.int64 if use_decimal else np.float64
         if k == "date":
@@ -122,7 +130,7 @@ def parse_dtype(s: str) -> DType:
         if kind not in ("decimal", "char", "varchar"):
             raise ValueError(f"bad parameterized type: {s}")
         return DType(kind, a, b)
-    if s in ("int32", "int64", "float64", "date", "string"):
+    if s in ("int32", "int64", "float64", "date", "string", "bool"):
         return DType(s)
     raise ValueError(f"unknown dtype: {s}")
 
@@ -133,6 +141,7 @@ INT64 = DType("int64")
 FLOAT64 = DType("float64")
 DATE = DType("date")
 STRING = DType("string")
+BOOL = DType("bool")
 
 
 def common_numeric(a: DType, b: DType) -> DType:

@@ -1,0 +1,293 @@
+"""Columnar data model for the TPU execution engine.
+
+The device-resident unit of work is a `Table`: a set of named `Column`s whose
+buffers are dense JAX arrays padded to a shared *capacity* (a power-of-two
+bucket >= the live row count). Padding + bucketing keeps the set of shapes the
+compiler sees small, so per-op `jit` caches stay warm across the 99-query
+stream even though every intermediate result has a different live row count
+(the TPU answer to dynamic result shapes of joins/filters — SURVEY.md §7
+"hard parts" #2).
+
+Representation choices (TPU-first, see nds_tpu/dtypes.py):
+  - integers / dates        -> int32 / int64 device buffers
+  - decimal(p,s)            -> scaled int64 (value * 10^s), exact add/sub/cmp
+  - char/varchar/string     -> int32 dictionary codes on device, the distinct
+                               values live host-side in a pyarrow array; all
+                               string functions are O(|dict|) host transforms
+                               plus an O(n) device gather
+  - NULLs                   -> separate bool validity buffer (None == all valid)
+
+Counterpart of the columnar-batch layer the reference delegates to cuDF device
+buffers via the rapids plugin (reference: nds/power_run_gpu.template:20-41
+configures it; the batches themselves live in the external engine).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from ..dtypes import DType, parse_dtype, INT64, FLOAT64
+
+jax.config.update("jax_enable_x64", True)
+
+# Minimum capacity bucket. 8*128 = one float32 VMEM tile's worth of lanes.
+_MIN_CAP = 1024
+
+
+def bucket_cap(n: int) -> int:
+    """Smallest power-of-two capacity >= n (>= _MIN_CAP)."""
+    cap = _MIN_CAP
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+def _pad_to(arr: jnp.ndarray, cap: int, fill=0) -> jnp.ndarray:
+    n = arr.shape[0]
+    if n == cap:
+        return arr
+    if n > cap:
+        raise ValueError(f"array longer ({n}) than capacity ({cap})")
+    return jnp.pad(arr, (0, cap - n), constant_values=fill)
+
+
+@dataclass(frozen=True)
+class Column:
+    """One column: device buffer + optional validity + optional dictionary.
+
+    `data` and `valid` are padded to the owning Table's capacity; entries at
+    index >= nrows are garbage and must never influence results (kernels mask
+    them with an iota < nrows predicate where it matters).
+    """
+
+    data: jnp.ndarray
+    dtype: DType
+    valid: Optional[jnp.ndarray] = None  # bool; None == all valid
+    dictionary: Optional[pa.Array] = None  # for string dtypes: distinct values
+
+    @property
+    def is_string(self) -> bool:
+        return self.dtype.is_string
+
+    def with_valid(self, valid: Optional[jnp.ndarray]) -> "Column":
+        return replace(self, valid=valid)
+
+
+@dataclass
+class Table:
+    """A named collection of equal-capacity columns with a live row count."""
+
+    columns: dict  # name -> Column (insertion-ordered)
+    nrows: int
+
+    @property
+    def cap(self) -> int:
+        for c in self.columns.values():
+            return int(c.data.shape[0])
+        return 0
+
+    @property
+    def names(self):
+        return list(self.columns.keys())
+
+    def column(self, name: str) -> Column:
+        return self.columns[name]
+
+    def select(self, names) -> "Table":
+        return Table({n: self.columns[n] for n in names}, self.nrows)
+
+    def rename(self, mapping: dict) -> "Table":
+        return Table(
+            {mapping.get(n, n): c for n, c in self.columns.items()}, self.nrows
+        )
+
+    def row_mask(self) -> jnp.ndarray:
+        """Bool mask of live rows (True for index < nrows)."""
+        return jnp.arange(self.cap, dtype=jnp.int32) < self.nrows
+
+
+# ---------------------------------------------------------------------------
+# Host <-> device conversion (Arrow is the host-side interchange format)
+# ---------------------------------------------------------------------------
+
+
+def _np_valid(arr: pa.Array) -> Optional[np.ndarray]:
+    if arr.null_count == 0:
+        return None
+    return pc.is_valid(arr).to_numpy(zero_copy_only=False)
+
+
+def column_from_arrow(arr: pa.ChunkedArray | pa.Array, dtype: DType, cap: int) -> Column:
+    """Decode one Arrow column into the device representation."""
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    valid_np = _np_valid(arr)
+    if dtype.is_string:
+        # Dictionary-encode on host; codes ride to HBM, values stay host-side.
+        if not pa.types.is_dictionary(arr.type):
+            arr = pc.dictionary_encode(arr)
+        codes = np.asarray(
+            arr.indices.fill_null(0).to_numpy(zero_copy_only=False), dtype=np.int32
+        )
+        dictionary = arr.dictionary
+        data = jnp.asarray(np.ascontiguousarray(codes))
+    else:
+        dictionary = None
+        if dtype.is_decimal:
+            if pa.types.is_decimal(arr.type):
+                # decimal128 -> scaled int64: multiply by 10^s as decimal
+                # (keeps exactness; p <= 18 covers all of TPC-DS), then the
+                # rescale-free cast to int64 is lossless.
+                import decimal
+
+                shift = pa.scalar(decimal.Decimal(10**dtype.scale))
+                scaled = pc.multiply(arr.cast(pa.decimal128(18, arr.type.scale)), shift)
+                np_vals = scaled.fill_null(0).cast(pa.int64()).to_numpy(
+                    zero_copy_only=False
+                )
+            else:
+                scale = 10 ** dtype.scale
+                f = arr.cast(pa.float64()).fill_null(0.0).to_numpy(zero_copy_only=False)
+                np_vals = np.round(f * scale).astype(np.int64)
+            np_vals = np.asarray(np_vals, dtype=np.int64)
+        elif dtype.kind == "date":
+            np_vals = arr.cast(pa.int32()).fill_null(0).to_numpy(zero_copy_only=False)
+        else:
+            npdt = dtype.device_np_dtype()
+            filled = arr.fill_null(0) if arr.null_count else arr
+            np_vals = np.asarray(
+                filled.to_numpy(zero_copy_only=False), dtype=npdt
+            )
+        data = jnp.asarray(np.ascontiguousarray(np_vals))
+    data = _pad_to(data, cap)
+    valid = None
+    if valid_np is not None:
+        valid = _pad_to(jnp.asarray(valid_np), cap, fill=False)
+    return Column(data, dtype, valid, dictionary)
+
+
+def table_from_arrow(batch: pa.Table | pa.RecordBatch, schema=None) -> Table:
+    """Build a device Table from an Arrow table.
+
+    `schema` (nds_tpu.schema.Schema) supplies logical types; if omitted they
+    are inferred from the Arrow types.
+    """
+    nrows = batch.num_rows
+    cap = bucket_cap(nrows)
+    cols = {}
+    if isinstance(batch, pa.RecordBatch):
+        batch = pa.Table.from_batches([batch])
+    for i, name in enumerate(batch.column_names):
+        if schema is not None and name in schema:
+            dtype = schema.field(name).dtype
+        else:
+            dtype = _infer_dtype(batch.schema.field(i).type)
+        cols[name] = column_from_arrow(batch.column(i), dtype, cap)
+    return Table(cols, nrows)
+
+
+def _infer_dtype(t: pa.DataType) -> DType:
+    if pa.types.is_int32(t) or pa.types.is_int16(t) or pa.types.is_int8(t):
+        return parse_dtype("int32")
+    if pa.types.is_int64(t):
+        return parse_dtype("int64")
+    if pa.types.is_floating(t):
+        return parse_dtype("float64")
+    if pa.types.is_decimal(t):
+        return DType("decimal", t.precision, t.scale)
+    if pa.types.is_date(t):
+        return parse_dtype("date")
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return parse_dtype("string")
+    if pa.types.is_dictionary(t):
+        return parse_dtype("string")
+    if pa.types.is_boolean(t):
+        return parse_dtype("int32")
+    raise ValueError(f"unsupported arrow type {t}")
+
+
+def column_to_arrow(col: Column, nrows: int) -> pa.Array:
+    """Materialize a device column back into Arrow (collect/write path)."""
+    data = np.asarray(col.data[:nrows])
+    valid = None if col.valid is None else np.asarray(col.valid[:nrows])
+    mask = None if valid is None else ~valid
+    dt = col.dtype
+    if dt.is_string:
+        codes = pa.array(data.astype(np.int32), mask=mask)
+        return pa.DictionaryArray.from_arrays(codes, col.dictionary).cast(pa.string())
+    if dt.is_decimal:
+        # Our int64s are *unscaled* decimal values; Arrow's int->decimal cast
+        # is value-preserving, so build the decimal128 buffer directly
+        # (low word = value, high word = sign extension).
+        ints = data.astype("<i8")
+        buf = np.empty((len(ints), 2), dtype="<i8")
+        buf[:, 0] = ints
+        buf[:, 1] = ints >> 63
+        validity = None
+        if mask is not None:
+            validity = pa.array(~mask).buffers()[1]
+        return pa.Array.from_buffers(
+            pa.decimal128(dt.precision, dt.scale),
+            len(ints),
+            [validity, pa.py_buffer(buf.tobytes())],
+        )
+    if dt.kind == "date":
+        return pa.array(data.astype(np.int32), mask=mask).cast(pa.date32())
+    if dt.kind == "bool":
+        return pa.array(data.astype(bool), mask=mask)
+    return pa.array(data, mask=mask)
+
+
+def table_to_arrow(table: Table) -> pa.Table:
+    arrays = [column_to_arrow(c, table.nrows) for c in table.columns.values()]
+    return pa.table(arrays, names=table.names)
+
+
+# ---------------------------------------------------------------------------
+# Dictionary utilities (string kernels run on the host over distinct values)
+# ---------------------------------------------------------------------------
+
+
+def unify_dictionaries(a: Column, b: Column):
+    """Remap two string columns onto one shared dictionary.
+
+    Needed before any cross-table comparison/join of string columns, because
+    codes are only meaningful within their own dictionary. Returns
+    (codes_a, codes_b, unified_dictionary); the remap is O(|dict|) on host +
+    O(n) gathers on device.
+    """
+    da = a.dictionary if a.dictionary is not None else pa.array([], type=pa.string())
+    db = b.dictionary if b.dictionary is not None else pa.array([], type=pa.string())
+    unified = pc.unique(pa.concat_arrays([da.cast(pa.string()), db.cast(pa.string())]))
+    remap_a = pc.index_in(da.cast(pa.string()), unified).to_numpy(zero_copy_only=False)
+    remap_b = pc.index_in(db.cast(pa.string()), unified).to_numpy(zero_copy_only=False)
+    ra = jnp.asarray(remap_a.astype(np.int32))
+    rb = jnp.asarray(remap_b.astype(np.int32))
+    codes_a = ra[jnp.clip(a.data, 0, max(len(da) - 1, 0))] if len(da) else a.data
+    codes_b = rb[jnp.clip(b.data, 0, max(len(db) - 1, 0))] if len(db) else b.data
+    return codes_a, codes_b, unified
+
+
+def sort_dictionary(col: Column):
+    """Return codes remapped so that code order == lexicographic value order.
+
+    Lets ORDER BY / min / max on strings run entirely on device: comparing
+    rank codes is comparing strings.
+    """
+    d = col.dictionary
+    if d is None:
+        return col.data, None
+    d = d.cast(pa.string())
+    order = pc.array_sort_indices(d)  # indices of values in sorted order
+    rank = np.empty(len(d), dtype=np.int32)
+    rank[order.to_numpy(zero_copy_only=False)] = np.arange(len(d), dtype=np.int32)
+    sorted_dict = d.take(order)
+    ranks = jnp.asarray(rank)[jnp.clip(col.data, 0, len(d) - 1)]
+    return ranks, sorted_dict

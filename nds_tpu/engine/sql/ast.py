@@ -1,0 +1,98 @@
+"""Relational AST for the NDS SQL dialect.
+
+Scalar expressions reuse the engine IR (nds_tpu.engine.expr) directly; this
+module only adds the relational shapes (SELECT, FROM items, set ops, DML).
+The dialect matches what the reference's patched query templates emit for
+Spark SQL (reference: nds/tpcds-gen/patches/templates.patch — `+ interval N
+days` date arithmetic, double-quoted aliases, ROLLUP, window functions).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class TableRef:
+    name: str
+    alias: Optional[str] = None
+
+
+@dataclass
+class SubqueryRef:
+    query: "SelectStmt"
+    alias: str
+
+
+@dataclass
+class JoinClause:
+    left: object
+    right: object
+    kind: str  # inner | left | right | full | cross
+    on: Optional[object] = None  # Expr
+
+
+@dataclass
+class OrderItem:
+    expr: object
+    ascending: bool = True
+    nulls_first: Optional[bool] = None  # None -> dialect default by direction
+
+
+@dataclass
+class SelectStmt:
+    select_items: list = field(default_factory=list)  # (Expr, alias|None) or ("*", qualifier|None)
+    distinct: bool = False
+    from_items: list = field(default_factory=list)
+    where: Optional[object] = None
+    group_by: list = field(default_factory=list)  # Exprs
+    rollup: bool = False
+    grouping_sets: Optional[list] = None
+    having: Optional[object] = None
+    order_by: list = field(default_factory=list)  # OrderItem
+    limit: Optional[int] = None
+    ctes: list = field(default_factory=list)  # (name, SelectStmt)
+    set_ops: list = field(default_factory=list)  # (op, SelectStmt); op in {union, union all, intersect, except}
+
+
+@dataclass
+class InsertStmt:
+    table: str
+    query: SelectStmt
+
+
+@dataclass
+class DeleteStmt:
+    table: str
+    where: Optional[object] = None
+
+
+@dataclass
+class CreateViewStmt:
+    name: str
+    query: SelectStmt
+    temp: bool = True
+
+
+@dataclass
+class DropViewStmt:
+    name: str
+
+
+@dataclass
+class CreateTableStmt:
+    name: str
+    query: SelectStmt  # CTAS only
+    using: Optional[str] = None
+    location: Optional[str] = None
+    partitioned_by: list = field(default_factory=list)
+
+
+@dataclass
+class CallStmt:
+    """CALL system.rollback_to_timestamp(...) — lakehouse procedures
+    (reference: nds/nds_rollback.py:46-51)."""
+
+    procedure: str
+    args: list = field(default_factory=list)

@@ -1,0 +1,803 @@
+"""SQL tokenizer + recursive-descent parser for the NDS dialect.
+
+Covers the surface the 99 TPC-DS query templates and 11 maintenance scripts
+need (reference: nds/tpcds-gen/patches/templates.patch; nds/data_maintenance/
+*.sql): WITH CTEs, joins (comma + ANSI), subqueries (scalar/IN/EXISTS),
+CASE/CAST, BETWEEN/IN/LIKE/IS NULL, UNION [ALL]/INTERSECT/EXCEPT, GROUP BY
+[ROLLUP], HAVING, window functions with frames, ORDER BY/LIMIT, INTERVAL date
+arithmetic, and the DML/DDL used by data maintenance (INSERT INTO ... SELECT,
+DELETE FROM ... WHERE, CREATE TEMP VIEW, CALL rollback procedures).
+
+Produces engine expression IR (nds_tpu.engine.expr) + relational AST
+(nds_tpu.engine.sql.ast); no external parser dependency.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+from ...dtypes import DType, parse_dtype
+from .. import expr as E
+from . import ast as A
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+|--[^\n]*)
+  | (?P<num>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+|\d+(?:[eE][+-]?\d+)?)
+  | (?P<str>'(?:[^']|'')*')
+  | (?P<qid>`[^`]+`|"[^"]+")
+  | (?P<id>[A-Za-z_][A-Za-z_0-9]*)
+  | (?P<op><=|>=|<>|!=|\|\||[+\-*/(),.=<>;])
+    """,
+    re.VERBOSE,
+)
+
+KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "order", "limit",
+    "as", "and", "or", "not", "in", "between", "like", "is", "null", "case",
+    "when", "then", "else", "end", "cast", "distinct", "union", "all",
+    "intersect", "except", "join", "inner", "left", "right", "full", "outer",
+    "cross", "on", "with", "exists", "interval", "date", "days", "day",
+    "rollup", "grouping", "sets", "over", "partition", "rows", "preceding",
+    "following", "unbounded", "current", "row", "asc", "desc", "nulls",
+    "first", "last", "insert", "into", "delete", "create", "drop", "table",
+    "view", "temp", "temporary", "using", "location", "partitioned", "call",
+    "values", "semi", "anti", "any", "some", "exists", "substring", "top",
+}
+
+
+class Token:
+    __slots__ = ("kind", "value", "pos")
+
+    def __init__(self, kind, value, pos):
+        self.kind = kind  # num str id qid op kw eof
+        self.value = value
+        self.pos = pos
+
+    def __repr__(self):
+        return f"Token({self.kind},{self.value!r})"
+
+
+def tokenize(sql: str):
+    out = []
+    pos = 0
+    n = len(sql)
+    while pos < n:
+        m = _TOKEN_RE.match(sql, pos)
+        if not m:
+            raise SyntaxError(f"bad character {sql[pos]!r} at {pos}: ...{sql[max(0,pos-30):pos+10]!r}")
+        pos = m.end()
+        if m.lastgroup == "ws":
+            continue
+        kind = m.lastgroup
+        val = m.group()
+        if kind == "id":
+            low = val.lower()
+            if low in KEYWORDS:
+                out.append(Token("kw", low, m.start()))
+            else:
+                out.append(Token("id", low, m.start()))
+        elif kind == "qid":
+            out.append(Token("id", val[1:-1].lower(), m.start()))
+        elif kind == "str":
+            out.append(Token("str", val[1:-1].replace("''", "'"), m.start()))
+        else:
+            out.append(Token(kind, val, m.start()))
+    out.append(Token("eof", None, n))
+    return out
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.sql = sql
+        self.toks = tokenize(sql)
+        self.i = 0
+
+    # ---- token helpers ---------------------------------------------------
+    def peek(self, k=0) -> Token:
+        return self.toks[min(self.i + k, len(self.toks) - 1)]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def at_kw(self, *kws) -> bool:
+        t = self.peek()
+        return t.kind == "kw" and t.value in kws
+
+    def at_op(self, *ops) -> bool:
+        t = self.peek()
+        return t.kind == "op" and t.value in ops
+
+    def accept_kw(self, *kws) -> bool:
+        if self.at_kw(*kws):
+            self.next()
+            return True
+        return False
+
+    def accept_op(self, *ops) -> bool:
+        if self.at_op(*ops):
+            self.next()
+            return True
+        return False
+
+    def expect_kw(self, kw):
+        if not self.accept_kw(kw):
+            self.err(f"expected {kw.upper()}")
+
+    def expect_op(self, op):
+        if not self.accept_op(op):
+            self.err(f"expected {op!r}")
+
+    def err(self, msg):
+        t = self.peek()
+        ctx = self.sql[max(0, t.pos - 40) : t.pos + 40]
+        raise SyntaxError(f"{msg}, got {t} near ...{ctx!r}")
+
+    # ---- entry points ----------------------------------------------------
+    def parse_statement(self):
+        if self.at_kw("select", "with") or self.at_op("("):
+            return self.parse_select()
+        if self.at_kw("insert"):
+            return self.parse_insert()
+        if self.at_kw("delete"):
+            return self.parse_delete()
+        if self.at_kw("create"):
+            return self.parse_create()
+        if self.at_kw("drop"):
+            return self.parse_drop()
+        if self.at_kw("call"):
+            return self.parse_call()
+        self.err("expected statement")
+
+    def parse_script(self):
+        """Parse a ';'-separated list of statements."""
+        stmts = []
+        while not self.peek().kind == "eof":
+            stmts.append(self.parse_statement())
+            while self.accept_op(";"):
+                pass
+        return stmts
+
+    # ---- statements ------------------------------------------------------
+    def parse_insert(self):
+        self.expect_kw("insert")
+        self.expect_kw("into")
+        name = self.qualified_name()
+        if self.at_kw("table"):  # INSERT INTO TABLE t
+            self.next()
+            name = self.qualified_name()
+        q = self.parse_select()
+        return A.InsertStmt(name, q)
+
+    def parse_delete(self):
+        self.expect_kw("delete")
+        self.expect_kw("from")
+        name = self.qualified_name()
+        where = None
+        if self.accept_kw("where"):
+            where = self.expr()
+        return A.DeleteStmt(name, where)
+
+    def parse_create(self):
+        self.expect_kw("create")
+        temp = self.accept_kw("temp", "temporary")
+        if self.accept_kw("view"):
+            name = self.qualified_name()
+            self.expect_kw("as")
+            q = self.parse_select()
+            return A.CreateViewStmt(name, q, temp=True if temp else temp)
+        self.expect_kw("table")
+        name = self.qualified_name()
+        using = None
+        location = None
+        parts = []
+        while True:
+            if self.accept_kw("using"):
+                using = self.next().value
+            elif self.accept_kw("partitioned"):
+                self.expect_kw("by")
+                self.expect_op("(")
+                while True:
+                    parts.append(self.next().value)
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+            elif self.accept_kw("location"):
+                location = self.next().value
+            else:
+                break
+        self.expect_kw("as")
+        q = self.parse_select()
+        return A.CreateTableStmt(name, q, using, location, parts)
+
+    def parse_drop(self):
+        self.expect_kw("drop")
+        self.expect_kw("view")
+        # IF EXISTS
+        if self.peek().kind == "id" and self.peek().value == "if":
+            self.next()
+            self.expect_kw("exists")
+        return A.DropViewStmt(self.qualified_name())
+
+    def parse_call(self):
+        self.expect_kw("call")
+        name = self.qualified_name()
+        args = []
+        self.expect_op("(")
+        if not self.at_op(")"):
+            while True:
+                # named arg: id => value
+                args.append(self.expr())
+                if not self.accept_op(","):
+                    break
+        self.expect_op(")")
+        return A.CallStmt(name, args)
+
+    def qualified_name(self) -> str:
+        parts = [self.next().value]
+        while self.accept_op("."):
+            parts.append(self.next().value)
+        return ".".join(parts)
+
+    # ---- SELECT ----------------------------------------------------------
+    def parse_select(self) -> A.SelectStmt:
+        ctes = []
+        if self.accept_kw("with"):
+            while True:
+                name = self.next().value
+                self.expect_kw("as")
+                self.expect_op("(")
+                sub = self.parse_select()
+                self.expect_op(")")
+                ctes.append((name, sub))
+                if not self.accept_op(","):
+                    break
+        stmt = self.parse_select_core()
+        stmt.ctes = ctes
+        # set operations
+        while self.at_kw("union", "intersect", "except"):
+            op = self.next().value
+            if op == "union" and self.accept_kw("all"):
+                op = "union all"
+            elif op in ("intersect", "except"):
+                self.accept_kw("all")  # treated as set semantics
+            rhs = self.parse_select_core_or_paren()
+            stmt.set_ops.append((op, rhs))
+        # trailing ORDER BY / LIMIT bind to the whole set expression
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            stmt.order_by = self.order_items()
+        if self.accept_kw("limit"):
+            stmt.limit = int(self.next().value)
+        return stmt
+
+    def parse_select_core_or_paren(self):
+        if self.accept_op("("):
+            s = self.parse_select()
+            self.expect_op(")")
+            return s
+        return self.parse_select_core()
+
+    def parse_select_core(self) -> A.SelectStmt:
+        if self.accept_op("("):
+            s = self.parse_select()
+            self.expect_op(")")
+            return s
+        self.expect_kw("select")
+        stmt = A.SelectStmt()
+        stmt.distinct = self.accept_kw("distinct")
+        self.accept_kw("all")
+        if self.accept_kw("top"):  # TOP n (some dsqgen dialects)
+            stmt.limit = int(self.next().value)
+        while True:
+            if self.at_op("*"):
+                self.next()
+                stmt.select_items.append(("*", None))
+            elif (
+                self.peek().kind == "id"
+                and self.peek(1).kind == "op"
+                and self.peek(1).value == "."
+                and self.peek(2).kind == "op"
+                and self.peek(2).value == "*"
+            ):
+                qual = self.next().value
+                self.next()
+                self.next()
+                stmt.select_items.append(("*", qual))
+            else:
+                e = self.expr()
+                alias = self.maybe_alias()
+                stmt.select_items.append((e, alias))
+            if not self.accept_op(","):
+                break
+        if self.accept_kw("from"):
+            stmt.from_items = self.from_list()
+        if self.accept_kw("where"):
+            stmt.where = self.expr()
+        if self.accept_kw("group"):
+            self.expect_kw("by")
+            if self.accept_kw("rollup"):
+                stmt.rollup = True
+                self.expect_op("(")
+                stmt.group_by = self.expr_list()
+                self.expect_op(")")
+            elif self.accept_kw("grouping"):
+                self.expect_kw("sets")
+                self.expect_op("(")
+                sets = []
+                while True:
+                    self.expect_op("(")
+                    if self.at_op(")"):
+                        sets.append([])
+                    else:
+                        sets.append(self.expr_list())
+                    self.expect_op(")")
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+                stmt.grouping_sets = sets
+                seen = []
+                for s in sets:
+                    for e in s:
+                        if e not in seen:
+                            seen.append(e)
+                stmt.group_by = seen
+            else:
+                stmt.group_by = self.expr_list()
+        if self.accept_kw("having"):
+            stmt.having = self.expr()
+        if self.at_kw("order") and not self._order_belongs_to_setop():
+            self.next()
+            self.expect_kw("by")
+            stmt.order_by = self.order_items()
+        if self.accept_kw("limit"):
+            stmt.limit = int(self.next().value)
+        return stmt
+
+    def _order_belongs_to_setop(self):
+        return False  # ORDER BY after a core select binds to it (no lookahead needed)
+
+    def order_items(self):
+        items = []
+        while True:
+            e = self.expr()
+            asc = True
+            if self.accept_kw("desc"):
+                asc = False
+            else:
+                self.accept_kw("asc")
+            nf = None
+            if self.accept_kw("nulls"):
+                if self.accept_kw("first"):
+                    nf = True
+                else:
+                    self.expect_kw("last")
+                    nf = False
+            items.append(A.OrderItem(e, asc, nf))
+            if not self.accept_op(","):
+                break
+        return items
+
+    def expr_list(self):
+        out = [self.expr()]
+        while self.accept_op(","):
+            out.append(self.expr())
+        return out
+
+    def maybe_alias(self) -> Optional[str]:
+        if self.accept_kw("as"):
+            return self.next().value
+        t = self.peek()
+        if t.kind == "id":
+            self.next()
+            return t.value
+        return None
+
+    # ---- FROM ------------------------------------------------------------
+    def from_list(self):
+        items = [self.join_chain()]
+        while self.accept_op(","):
+            items.append(self.join_chain())
+        return items
+
+    def join_chain(self):
+        left = self.table_primary()
+        while True:
+            kind = None
+            if self.accept_kw("inner"):
+                kind = "inner"
+            elif self.accept_kw("left"):
+                self.accept_kw("outer")
+                kind = "left"
+                if self.accept_kw("semi"):
+                    kind = "semi"
+                elif self.accept_kw("anti"):
+                    kind = "anti"
+            elif self.accept_kw("right"):
+                self.accept_kw("outer")
+                kind = "right"
+            elif self.accept_kw("full"):
+                self.accept_kw("outer")
+                kind = "full"
+            elif self.accept_kw("cross"):
+                kind = "cross"
+            elif self.at_kw("join"):
+                kind = "inner"
+            if kind is None:
+                return left
+            self.expect_kw("join")
+            right = self.table_primary()
+            on = None
+            if kind != "cross":
+                self.expect_kw("on")
+                on = self.expr()
+            left = A.JoinClause(left, right, kind, on)
+
+    def table_primary(self):
+        if self.accept_op("("):
+            if self.at_kw("select", "with"):
+                q = self.parse_select()
+                self.expect_op(")")
+                alias = self.maybe_alias() or f"_subq{self.i}"
+                return A.SubqueryRef(q, alias)
+            j = self.join_chain()
+            self.expect_op(")")
+            return j
+        name = self.qualified_name()
+        alias = self.maybe_alias()
+        return A.TableRef(name, alias)
+
+    # ---- expressions -----------------------------------------------------
+    def expr(self) -> E.Expr:
+        return self.or_expr()
+
+    def or_expr(self):
+        left = self.and_expr()
+        while self.accept_kw("or"):
+            left = E.BinOp("or", left, self.and_expr())
+        return left
+
+    def and_expr(self):
+        left = self.not_expr()
+        while self.accept_kw("and"):
+            left = E.BinOp("and", left, self.not_expr())
+        return left
+
+    def not_expr(self):
+        if self.accept_kw("not"):
+            return E.UnaryOp("not", self.not_expr())
+        return self.predicate()
+
+    def predicate(self):
+        if self.at_kw("exists"):
+            self.next()
+            self.expect_op("(")
+            q = self.parse_select()
+            self.expect_op(")")
+            return E.SubqueryExpr(q, "exists")
+        left = self.additive()
+        while True:
+            if self.at_op("=", "<>", "!=", "<", "<=", ">", ">="):
+                op = self.next().value
+                if op == "!=":
+                    op = "<>"
+                # comparison with quantified/scalar subquery
+                if self.at_op("(") and self.peek(1).kind == "kw" and self.peek(1).value in ("select", "with"):
+                    self.next()
+                    q = self.parse_select()
+                    self.expect_op(")")
+                    right = E.SubqueryExpr(q, "scalar")
+                else:
+                    right = self.additive()
+                left = E.BinOp(op, left, right)
+                continue
+            negated = False
+            save = self.i
+            if self.accept_kw("not"):
+                negated = True
+            if self.accept_kw("between"):
+                lo = self.additive()
+                self.expect_kw("and")
+                hi = self.additive()
+                left = E.Between(left, lo, hi, negated)
+                continue
+            if self.accept_kw("in"):
+                self.expect_op("(")
+                if self.at_kw("select", "with"):
+                    q = self.parse_select()
+                    self.expect_op(")")
+                    left = E.SubqueryExpr(q, "in", left, negated)
+                else:
+                    vals = []
+                    while True:
+                        v = self.additive()
+                        vals.append(v)
+                        if not self.accept_op(","):
+                            break
+                    self.expect_op(")")
+                    vals = tuple(_as_lit(v) for v in vals)
+                    left = E.InList(left, vals, negated)
+                continue
+            if self.accept_kw("like"):
+                pat = self.next()
+                left = E.Like(left, pat.value, negated)
+                continue
+            if negated:
+                self.i = save
+                break
+            if self.accept_kw("is"):
+                neg = self.accept_kw("not")
+                self.expect_kw("null")
+                left = E.UnaryOp("isnotnull" if neg else "isnull", left)
+                continue
+            break
+        return left
+
+    def additive(self):
+        left = self.multiplicative()
+        while True:
+            if self.at_op("+", "-"):
+                op = self.next().value
+                right = self.multiplicative()
+                if isinstance(right, E.Interval):
+                    fn = "date_add" if op == "+" else "date_sub"
+                    left = E.Func(fn, (left, E.Lit(right.days)))
+                else:
+                    left = E.BinOp(op, left, right)
+            elif self.at_op("||"):
+                self.next()
+                left = E.BinOp("||", left, self.multiplicative())
+            else:
+                return left
+
+    def multiplicative(self):
+        left = self.unary()
+        while self.at_op("*", "/"):
+            op = self.next().value
+            left = E.BinOp(op, left, self.unary())
+        return left
+
+    def unary(self):
+        if self.accept_op("-"):
+            operand = self.unary()
+            if isinstance(operand, E.Lit) and isinstance(operand.value, (int, float)):
+                return E.Lit(-operand.value, operand.dtype)
+            return E.UnaryOp("neg", operand)
+        if self.accept_op("+"):
+            return self.unary()
+        return self.primary()
+
+    def primary(self):
+        t = self.peek()
+        if t.kind == "num":
+            self.next()
+            if "." in t.value or "e" in t.value.lower():
+                if "e" in t.value.lower():
+                    return E.Lit(float(t.value))
+                # exact decimal literal
+                frac = t.value.split(".")[1] if "." in t.value else ""
+                scale = len(frac)
+                return E.Lit(float(t.value), DType("decimal", 38, scale))
+            return E.Lit(int(t.value))
+        if t.kind == "str":
+            self.next()
+            return E.Lit(t.value)
+        if self.accept_op("("):
+            if self.at_kw("select", "with"):
+                q = self.parse_select()
+                self.expect_op(")")
+                return E.SubqueryExpr(q, "scalar")
+            e = self.expr()
+            self.expect_op(")")
+            return e
+        if self.at_kw("case"):
+            return self.case_expr()
+        if self.at_kw("cast"):
+            return self.cast_expr()
+        if self.at_kw("null"):
+            self.next()
+            return E.Lit(None)
+        if self.at_kw("interval"):
+            self.next()
+            v = self.next()  # number or string
+            n = int(v.value)
+            self.accept_kw("days", "day")
+            return E.Interval(n)
+        if self.at_kw("date"):
+            # DATE 'yyyy-mm-dd' literal, or a column named `date`
+            if self.peek(1).kind == "str":
+                self.next()
+                s = self.next().value
+                return E.Lit(s, parse_dtype("date"))
+            self.next()
+            return E.Col("date")
+        if self.at_kw("exists"):
+            return self.predicate()
+        if self.at_kw("grouping"):
+            self.next()
+            self.expect_op("(")
+            arg = self.expr()
+            self.expect_op(")")
+            return E.Agg("grouping", arg)
+        if self.at_kw("distinct"):
+            # e.g. count(distinct x) handled in func call; bare distinct invalid
+            self.err("unexpected DISTINCT")
+        if self.at_kw("substring"):
+            self.next()
+            self.expect_op("(")
+            a = self.expr()
+            if self.accept_op(","):
+                b = self.expr()
+                self.expect_op(",")
+                c = self.expr()
+            else:
+                self.expect_kw("from")
+                b = self.expr()
+                self.expect_kw("for")
+                c = self.expr()
+            self.expect_op(")")
+            return E.Func("substr", (a, b, c))
+        if t.kind in ("id", "kw"):
+            name = self.next().value
+            if self.at_op("(") :
+                return self.func_call(name)
+            if self.accept_op("."):
+                col = self.next().value
+                return E.Col(col, name)
+            return E.Col(name)
+        self.err("expected expression")
+
+    _AGG_FNS = {"sum", "avg", "count", "min", "max", "stddev_samp", "stddev", "var_samp"}
+    _WIN_FNS = {"rank", "dense_rank", "row_number", "ntile", "lag", "lead", "first_value", "last_value"}
+
+    def func_call(self, name):
+        self.expect_op("(")
+        distinct = False
+        args = []
+        if self.at_op("*"):
+            self.next()
+            args = []
+            star = True
+        else:
+            star = False
+            if not self.at_op(")"):
+                distinct = self.accept_kw("distinct")
+                args = self.expr_list()
+        self.expect_op(")")
+        over = None
+        if self.accept_kw("over"):
+            over = self.window_spec()
+        if name in self._AGG_FNS and over is None:
+            if name == "count" and star:
+                return E.Agg("count", None, distinct)
+            if name == "stddev":
+                name = "stddev_samp"
+            return E.Agg(name, args[0] if args else None, distinct)
+        if over is not None:
+            partition_by, order_by, frame = over
+            arg = args[0] if args else None
+            fn = name
+            if name == "count" and star:
+                arg = None
+            return E.WindowFn(fn, arg, tuple(partition_by), tuple(order_by), frame)
+        return E.Func(name, tuple(args))
+
+    def window_spec(self):
+        self.expect_op("(")
+        partition_by = []
+        order_by = []
+        frame = None
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            partition_by = self.expr_list()
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            for it in self.order_items():
+                order_by.append((it.expr, it.ascending))
+        if self.accept_kw("rows"):
+            frame = self.frame_spec()
+        self.expect_op(")")
+        return partition_by, order_by, frame
+
+    def frame_spec(self):
+        def bound():
+            if self.accept_kw("unbounded"):
+                which = self.next().value  # preceding / following
+                return ("unbounded", which)
+            if self.accept_kw("current"):
+                self.expect_kw("row")
+                return ("current", None)
+            n = int(self.next().value)
+            which = self.next().value
+            return (n, which)
+
+        if self.accept_kw("between"):
+            lo = bound()
+            self.expect_kw("and")
+            hi = bound()
+            return (lo, hi)
+        lo = bound()
+        return (lo, ("current", None))
+
+    def case_expr(self):
+        self.expect_kw("case")
+        operand = None
+        if not self.at_kw("when"):
+            operand = self.expr()
+        branches = []
+        while self.accept_kw("when"):
+            cond = self.expr()
+            self.expect_kw("then")
+            val = self.expr()
+            if operand is not None:
+                cond = E.BinOp("=", operand, cond)
+            branches.append((cond, val))
+        default = None
+        if self.accept_kw("else"):
+            default = self.expr()
+        self.expect_kw("end")
+        return E.Case(tuple(branches), default)
+
+    def cast_expr(self):
+        self.expect_kw("cast")
+        self.expect_op("(")
+        e = self.expr()
+        self.expect_kw("as")
+        target = self.type_name()
+        self.expect_op(")")
+        return E.Cast(e, target)
+
+    def type_name(self) -> DType:
+        t = self.next()
+        name = t.value
+        if name in ("integer", "int"):
+            return parse_dtype("int32")
+        if name == "bigint":
+            return parse_dtype("int64")
+        if name == "smallint":
+            return parse_dtype("int32")
+        if name in ("double", "float", "real"):
+            return parse_dtype("float64")
+        if name in ("string",):
+            return parse_dtype("string")
+        if name == "date":
+            return parse_dtype("date")
+        if name in ("decimal", "numeric", "char", "varchar"):
+            if self.accept_op("("):
+                a = int(self.next().value)
+                b = 0
+                if self.accept_op(","):
+                    b = int(self.next().value)
+                self.expect_op(")")
+                if name in ("decimal", "numeric"):
+                    return DType("decimal", a, b)
+                return DType(name, a)
+            if name in ("decimal", "numeric"):
+                return DType("decimal", 10, 0)
+            return parse_dtype("string")
+        raise SyntaxError(f"unknown type {name}")
+
+
+def _as_lit(e):
+    if isinstance(e, E.Lit):
+        return e
+    if isinstance(e, E.Cast) and isinstance(e.operand, E.Lit):
+        return e.operand
+    raise SyntaxError(f"IN list must be literals, got {e}")
+
+
+def parse_sql(sql: str):
+    p = Parser(sql)
+    stmt = p.parse_statement()
+    while p.accept_op(";"):
+        pass
+    if p.peek().kind != "eof":
+        p.err("trailing tokens")
+    return stmt
+
+
+def parse_script(sql: str):
+    return Parser(sql).parse_script()

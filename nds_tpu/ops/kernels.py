@@ -1,0 +1,302 @@
+"""Device kernel library: the cuDF-equivalent relational primitives.
+
+These are the hot ops the reference delegates to the external RAPIDS/cuDF
+engine (reference: BASELINE.json north star; nds/power_run_gpu.template:20-41
+merely configures them). Here each primitive is a `jit`-compiled JAX function
+over dense padded buffers:
+
+  - compaction (filter)          nonzero + gather
+  - equi-join (inner/outer/semi/anti)  hash + sort + searchsorted + verify
+  - group-by aggregation         lexsort + boundary flags + segment reduce
+  - order-by                     lexsort with null ordering + live-row key
+  - window functions             partition sort + segment scan/reduce
+
+Design rules (TPU/XLA-first):
+  * Every output is padded to a power-of-two bucket (`columnar.bucket_cap`) so
+    recompiles are bounded by O(log n) distinct shapes per kernel, not O(#ops).
+  * No data-dependent shapes inside jit: live counts cross to the host once
+    per kernel (`int(x.sum())`) and select the bucket for the next kernel.
+  * Hash matches are *candidates only*: every join verifies real key equality
+    on the matched pairs, so hash collisions can never produce wrong results.
+  * Sorting uses `jnp.lexsort` (XLA's bitonic/radix sort, fast on TPU); the
+    most-significant key is always the live-row mask so padding tails sort to
+    the end and drop out.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_enable_x64", True)
+
+I64 = jnp.int64
+U64 = jnp.uint64
+
+
+# ---------------------------------------------------------------------------
+# Hashing
+# ---------------------------------------------------------------------------
+
+
+def _splitmix64(x: jnp.ndarray) -> jnp.ndarray:
+    """splitmix64 finalizer; good avalanche, cheap on the VPU."""
+    x = x.astype(U64)
+    x = (x + jnp.uint64(0x9E3779B97F4A7C15)).astype(U64)
+    x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    x = x ^ (x >> jnp.uint64(31))
+    return x
+
+
+def hash_columns(cols, valids) -> jnp.ndarray:
+    """Combine N key columns (+ their null flags) into one int64 hash."""
+    h = jnp.uint64(0x243F6A8885A308D3)
+    for data, valid in zip(cols, valids):
+        k = _splitmix64(data.astype(I64))
+        if valid is not None:
+            # null participates as its own distinct value
+            k = jnp.where(valid, k, jnp.uint64(0xA5A5A5A5A5A5A5A5))
+        h = _splitmix64(h * jnp.uint64(31) + k)
+    return h.astype(I64)
+
+
+# ---------------------------------------------------------------------------
+# Compaction (filter)
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("out_cap",))
+def compact_indices(mask: jnp.ndarray, out_cap: int) -> jnp.ndarray:
+    """Indices of True entries, padded with 0 to out_cap."""
+    return jnp.nonzero(mask, size=out_cap, fill_value=0)[0].astype(jnp.int32)
+
+
+def mask_count(mask: jnp.ndarray) -> int:
+    return int(jnp.sum(mask))
+
+
+# ---------------------------------------------------------------------------
+# Sorting
+# ---------------------------------------------------------------------------
+
+
+def sort_indices(keys, live_mask: jnp.ndarray) -> jnp.ndarray:
+    """Stable multi-key sort; returns row order with live rows first.
+
+    `keys` is a list of (data:int64/float64, valid:bool|None, ascending:bool,
+    nulls_first:bool) in major-to-minor significance order. Null ordering and
+    direction are folded into a (null_rank, value) key pair per column.
+    """
+    lex = []  # least-significant first for jnp.lexsort
+    for data, valid, ascending, nulls_first in reversed(keys):
+        d = data
+        if jnp.issubdtype(d.dtype, jnp.integer):
+            d = d.astype(I64)
+        if not ascending:
+            d = -d
+        if valid is not None:
+            null_rank = jnp.where(valid, jnp.int32(0),
+                                  jnp.int32(-1 if nulls_first else 1))
+            d = jnp.where(valid, d, 0)
+            lex.append(d)
+            lex.append(null_rank)
+        else:
+            lex.append(d)
+    lex.append(~live_mask)  # most significant: dead rows last
+    return jnp.lexsort(tuple(lex)).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Grouping (sort-based): group ids + segment reductions
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=())
+def _group_flags(sorted_keys, sorted_valids, live_sorted):
+    """Boundary flags over rows sorted by their group keys."""
+    n = live_sorted.shape[0]
+    flag = jnp.zeros(n, dtype=bool).at[0].set(True)
+    for data, valid in zip(sorted_keys, sorted_valids):
+        if valid is not None:
+            data = jnp.where(valid, data, jnp.zeros((), data.dtype))
+            # split iff nullness differs, or both non-null with unequal values
+            neq = (valid[1:] != valid[:-1]) | (
+                valid[1:] & valid[:-1] & (data[1:] != data[:-1])
+            )
+        else:
+            neq = data[1:] != data[:-1]
+        flag = flag.at[1:].max(neq)
+    # dead rows: open one trailing group so they never merge with a live one
+    dead_start = jnp.roll(live_sorted, 1) & ~live_sorted
+    flag = flag | dead_start
+    return flag
+
+
+def group_rows(keys, valids, live_mask):
+    """Sort rows so equal keys are adjacent and assign group ids.
+
+    Returns (order, gid_sorted, ngroups): `order` the sorted row order,
+    `gid_sorted[i]` the 0-based group of sorted row i, `ngroups` the number of
+    live groups (host int). Nulls form their own group (Spark GROUP BY
+    semantics).
+    """
+    sort_keys = []
+    for data, valid in zip(keys, valids):
+        sort_keys.append((data, valid, True, True))
+    order = sort_indices(sort_keys, live_mask)
+    sorted_keys = [k[order] for k, _ in zip(keys, valids)]
+    sorted_valids = [None if v is None else v[order] for v in valids]
+    live_sorted = live_mask[order]
+    flags = _group_flags(sorted_keys, sorted_valids, live_sorted)
+    gid = jnp.cumsum(flags.astype(jnp.int32)) - 1
+    nlive = mask_count(live_mask)
+    if nlive == 0:
+        return order, gid, 0
+    ngroups = int(gid[nlive - 1]) + 1
+    return order, gid, ngroups
+
+
+@partial(jax.jit, static_argnames=("num_segments", "op"))
+def segment_reduce(vals, gid, weight, num_segments, op):
+    """Segment reduction with a live/validity weight mask.
+
+    op: sum | min | max | count | sumsq
+    """
+    if op == "count":
+        return jax.ops.segment_sum(weight.astype(I64), gid, num_segments)
+    if op == "sum":
+        v = jnp.where(weight, vals, jnp.zeros((), vals.dtype))
+        return jax.ops.segment_sum(v, gid, num_segments)
+    if op == "sumsq":
+        v = jnp.where(weight, vals.astype(jnp.float64) ** 2, 0.0)
+        return jax.ops.segment_sum(v, gid, num_segments)
+    if op == "min":
+        big = _extreme(vals.dtype, True)
+        v = jnp.where(weight, vals, big)
+        return jax.ops.segment_min(v, gid, num_segments)
+    if op == "max":
+        small = _extreme(vals.dtype, False)
+        v = jnp.where(weight, vals, small)
+        return jax.ops.segment_max(v, gid, num_segments)
+    raise ValueError(op)
+
+
+def _extreme(dtype, is_max):
+    if jnp.issubdtype(dtype, jnp.integer):
+        info = jnp.iinfo(dtype)
+        return jnp.asarray(info.max if is_max else info.min, dtype)
+    return jnp.asarray(jnp.inf if is_max else -jnp.inf, dtype)
+
+
+# ---------------------------------------------------------------------------
+# Equi-join
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=())
+def _join_prepare(rhash, rlive):
+    """Sort right-side hashes; dead rows get a reserved slot at the end."""
+    rh = jnp.where(rlive, rhash, jnp.iinfo(I64).max)
+    order = jnp.argsort(rh).astype(jnp.int32)
+    return rh[order], order
+
+
+@partial(jax.jit, static_argnames=())
+def _join_counts(rh_sorted, lhash, llive):
+    lh = jnp.where(llive, lhash, jnp.iinfo(I64).min)
+    lo = jnp.searchsorted(rh_sorted, lh, side="left").astype(jnp.int32)
+    hi = jnp.searchsorted(rh_sorted, lh, side="right").astype(jnp.int32)
+    counts = jnp.where(llive, hi - lo, 0)
+    return lo, counts
+
+
+@partial(jax.jit, static_argnames=("out_cap",))
+def _join_expand(lo, counts, rorder, out_cap):
+    """Expand (row, count) pairs into candidate (li, ri) index pairs."""
+    offs = jnp.cumsum(counts) - counts  # exclusive prefix
+    total = jnp.sum(counts)
+    p = jnp.arange(out_cap, dtype=jnp.int64)
+    li = (jnp.searchsorted(offs + counts, p, side="right")).astype(jnp.int32)
+    li = jnp.clip(li, 0, lo.shape[0] - 1)
+    j = (p - offs[li]).astype(jnp.int32)
+    ri_sorted_pos = jnp.clip(lo[li] + j, 0, rorder.shape[0] - 1)
+    ri = rorder[ri_sorted_pos]
+    pair_live = p < total
+    return li, ri, pair_live
+
+
+def join_candidates(lkeys, lvalids, llive, rkeys, rvalids, rlive):
+    """Hash-match candidate pairs; caller MUST verify real key equality.
+
+    Returns (li, ri, pair_live, total_candidates). Rows with any null key
+    never match (SQL equality semantics).
+    """
+    lh = hash_columns(lkeys, lvalids)
+    rh = hash_columns(rkeys, rvalids)
+    lnn = _all_valid(lvalids, llive)
+    rnn = _all_valid(rvalids, rlive)
+    rh_sorted, rorder = _join_prepare(rh, rnn)
+    lo, counts = _join_counts(rh_sorted, lh, lnn)
+    total = int(jnp.sum(counts))
+    from ..engine.columnar import bucket_cap
+
+    out_cap = bucket_cap(max(total, 1))
+    li, ri, pair_live = _join_expand(lo, counts, rorder, out_cap)
+    return li, ri, pair_live, total
+
+
+def _all_valid(valids, live):
+    m = live
+    for v in valids:
+        if v is not None:
+            m = m & v
+    return m
+
+
+@partial(jax.jit, static_argnames=())
+def verify_pairs(li, ri, pair_live, lkeys, lvalids, llive, rkeys, rvalids, rlive):
+    """AND real key equality into the candidate mask (collision shield)."""
+    ok = pair_live & llive[li] & rlive[ri]
+    for (ld, lv), (rd, rv) in zip(zip(lkeys, lvalids), zip(rkeys, rvalids)):
+        eq = ld[li].astype(I64) == rd[ri].astype(I64)
+        if lv is not None:
+            eq = eq & lv[li]
+        if rv is not None:
+            eq = eq & rv[ri]
+        ok = ok & eq
+    return ok
+
+
+@partial(jax.jit, static_argnames=("cap",))
+def matched_mask(li, ok, cap):
+    """Per-left-row flag: does row have at least one verified match?"""
+    return jnp.zeros(cap, dtype=bool).at[li].max(ok)
+
+
+# ---------------------------------------------------------------------------
+# Window helpers
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("num_segments",))
+def segment_starts(gid, num_segments):
+    """Index of the first sorted row of each segment."""
+    n = gid.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    return jax.ops.segment_min(idx, gid, num_segments)
+
+
+@partial(jax.jit, static_argnames=())
+def running_position(gid):
+    """0-based position of each sorted row within its segment."""
+    n = gid.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    first = jnp.zeros(n, dtype=bool).at[0].set(True)
+    first = first.at[1:].max(gid[1:] != gid[:-1])
+    start_of_own = jnp.where(first, idx, 0)
+    seg_start = jax.lax.associative_scan(jnp.maximum, start_of_own)
+    return idx - seg_start

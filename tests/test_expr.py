@@ -1,0 +1,163 @@
+"""Expression evaluator tests: arithmetic, 3VL, strings, decimals, dates."""
+
+from decimal import Decimal
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from nds_tpu.dtypes import DType, parse_dtype
+from nds_tpu.engine import expr as E
+from nds_tpu.engine.columnar import table_from_arrow, column_to_arrow
+
+
+def _table(**cols):
+    names = list(cols)
+    arrays = [pa.array(v[1], type=v[0]) for v in cols.values()]
+    return table_from_arrow(pa.table(arrays, names=names))
+
+
+@pytest.fixture
+def t():
+    return _table(
+        a=(pa.int32(), [1, 2, None, 4, 5]),
+        b=(pa.int32(), [10, 20, 30, None, 50]),
+        f=(pa.float64(), [1.5, 2.5, 3.5, 4.5, None]),
+        d=(
+            pa.decimal128(7, 2),
+            [Decimal("1.10"), Decimal("2.20"), Decimal("3.30"), None, Decimal("5.50")],
+        ),
+        s=(pa.string(), ["apple", "banana", None, "cherry", "apple"]),
+        dt=(pa.date32(), [0, 1, 2, 3, 4]),
+    )
+
+
+def _vals(col, t):
+    return column_to_arrow(col, t.nrows).to_pylist()
+
+
+def test_add(t):
+    out = E.Evaluator(t).eval(E.BinOp("+", E.Col("a"), E.Col("b")))
+    assert _vals(out, t) == [11, 22, None, None, 55]
+
+
+def test_decimal_mul_scale(t):
+    out = E.Evaluator(t).eval(E.BinOp("*", E.Col("d"), E.Col("d")))
+    assert out.dtype.scale == 4
+    got = _vals(out, t)
+    assert str(got[0]) == "1.2100"
+    assert got[3] is None
+
+
+def test_division_null_on_zero():
+    t = _table(x=(pa.int32(), [10, 10]), y=(pa.int32(), [2, 0]))
+    out = E.Evaluator(t).eval(E.BinOp("/", E.Col("x"), E.Col("y")))
+    assert _vals(out, t) == [5.0, None]
+
+
+def test_compare_and_3vl(t):
+    # (a > 1) AND (b > 10): row2 null AND true -> null; row3 true AND null -> null
+    e = E.BinOp(
+        "and",
+        E.BinOp(">", E.Col("a"), E.Lit(1)),
+        E.BinOp(">", E.Col("b"), E.Lit(10)),
+    )
+    out = E.Evaluator(t).eval(e)
+    assert _vals(out, t) == [False, True, None, None, True]
+
+
+def test_or_short_circuit_null():
+    t = _table(a=(pa.int32(), [1, None]), b=(pa.int32(), [5, 5]))
+    e = E.BinOp(
+        "or",
+        E.BinOp("=", E.Col("a"), E.Lit(99)),
+        E.BinOp("=", E.Col("b"), E.Lit(5)),
+    )
+    out = E.Evaluator(t).eval(e)
+    # null OR true -> true
+    assert _vals(out, t) == [True, True]
+
+
+def test_string_eq_literal(t):
+    out = E.Evaluator(t).eval(E.BinOp("=", E.Col("s"), E.Lit("apple")))
+    assert _vals(out, t) == [True, False, None, False, True]
+
+
+def test_like(t):
+    out = E.Evaluator(t).eval(E.Like(E.Col("s"), "%an%"))
+    assert _vals(out, t) == [False, True, None, False, False]
+
+
+def test_in_list_strings(t):
+    out = E.Evaluator(t).eval(
+        E.InList(E.Col("s"), (E.Lit("apple"), E.Lit("cherry")))
+    )
+    assert _vals(out, t) == [True, False, None, True, True]
+
+
+def test_between(t):
+    out = E.Evaluator(t).eval(E.Between(E.Col("a"), E.Lit(2), E.Lit(4)))
+    assert _vals(out, t) == [False, True, None, True, False]
+
+
+def test_case_when(t):
+    e = E.Case(
+        branches=(
+            (E.BinOp(">", E.Col("a"), E.Lit(3)), E.Lit("big")),
+            (E.BinOp(">", E.Col("a"), E.Lit(1)), E.Lit("mid")),
+        ),
+        default=E.Lit("small"),
+    )
+    out = E.Evaluator(t).eval(e)
+    assert _vals(out, t) == ["small", "mid", "small", "big", "big"]
+
+
+def test_substr(t):
+    out = E.Evaluator(t).eval(E.Func("substr", (E.Col("s"), E.Lit(1), E.Lit(3))))
+    assert _vals(out, t) == ["app", "ban", None, "che", "app"]
+
+
+def test_coalesce(t):
+    out = E.Evaluator(t).eval(E.Func("coalesce", (E.Col("a"), E.Lit(0))))
+    assert _vals(out, t) == [1, 2, 0, 4, 5]
+
+
+def test_is_null(t):
+    out = E.Evaluator(t).eval(E.UnaryOp("isnull", E.Col("a")))
+    assert _vals(out, t) == [False, False, True, False, False]
+
+
+def test_date_interval(t):
+    e = E.BinOp("+", E.Col("dt"), E.Func("date_days", (E.Lit(30),)))
+    # date + int literal also works through the + path
+    out = E.Evaluator(t).eval(E.BinOp("+", E.Col("dt"), E.Lit(30)))
+    assert _vals(out, t)[0].isoformat() == "1970-01-31"
+
+
+def test_date_compare_literal(t):
+    e = E.BinOp(">=", E.Col("dt"), E.Lit("1970-01-03", parse_dtype("date")))
+    out = E.Evaluator(t).eval(e)
+    assert _vals(out, t) == [False, False, True, True, True]
+
+
+def test_cast_decimal_to_float(t):
+    out = E.Evaluator(t).eval(E.Cast(E.Col("d"), parse_dtype("float64")))
+    got = _vals(out, t)
+    assert got[0] == pytest.approx(1.10)
+
+
+def test_concat_literal(t):
+    out = E.Evaluator(t).eval(E.BinOp("||", E.Col("s"), E.Lit("-x")))
+    assert _vals(out, t) == ["apple-x", "banana-x", None, "cherry-x", "apple-x"]
+
+
+def test_round_decimal(t):
+    out = E.Evaluator(t).eval(E.Func("round", (E.Col("d"), E.Lit(1))))
+    got = _vals(out, t)
+    assert str(got[0]) == "1.10"
+    assert str(got[1]) == "2.20"
+
+
+def test_year(t):
+    out = E.Evaluator(t).eval(E.Func("year", (E.Col("dt"),)))
+    assert _vals(out, t) == [1970] * 5

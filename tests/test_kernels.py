@@ -1,0 +1,220 @@
+"""Unit tests for the device kernel library against numpy oracles.
+
+This exceeds the reference's test strategy on purpose (SURVEY.md §4: the
+reference has no unit tests; we unit-test every kernel)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nds_tpu.engine.columnar import bucket_cap
+from nds_tpu.ops import kernels as K
+
+rng = np.random.default_rng(42)
+
+
+def _pad(a, cap, fill=0):
+    return np.concatenate([a, np.full(cap - len(a), fill, a.dtype)])
+
+
+def _live(n, cap):
+    return jnp.arange(cap) < n
+
+
+class TestCompact:
+    def test_compact(self):
+        n, cap = 1000, 1024
+        mask = rng.random(cap) < 0.3
+        mask[n:] = False
+        count = K.mask_count(jnp.asarray(mask))
+        assert count == mask.sum()
+        idx = K.compact_indices(jnp.asarray(mask), bucket_cap(count))
+        np.testing.assert_array_equal(
+            np.asarray(idx)[:count], np.nonzero(mask)[0]
+        )
+
+
+class TestSort:
+    def test_single_key_asc(self):
+        n, cap = 900, 1024
+        data = rng.integers(0, 100, cap).astype(np.int64)
+        order = K.sort_indices(
+            [(jnp.asarray(data), None, True, True)], _live(n, cap)
+        )
+        got = data[np.asarray(order)[:n]]
+        np.testing.assert_array_equal(got, np.sort(data[:n]))
+
+    def test_desc_and_nulls(self):
+        n, cap = 500, 512
+        data = rng.integers(0, 50, cap).astype(np.int64)
+        valid = rng.random(cap) < 0.8
+        order = K.sort_indices(
+            [(jnp.asarray(data), jnp.asarray(valid), False, False)],
+            _live(n, cap),
+        )
+        o = np.asarray(order)[:n]
+        vals, vs = data[o], valid[o]
+        # all invalids at the end (nulls last), values descending before that
+        k = vs.sum()
+        assert (~vs[k:]).all()
+        assert (np.diff(vals[:k]) <= 0).all()
+
+    def test_multi_key_stability(self):
+        n = cap = 1024
+        k1 = rng.integers(0, 4, cap).astype(np.int64)
+        k2 = rng.integers(0, 1000, cap).astype(np.int64)
+        order = np.asarray(
+            K.sort_indices(
+                [
+                    (jnp.asarray(k1), None, True, True),
+                    (jnp.asarray(k2), None, False, True),
+                ],
+                _live(n, cap),
+            )
+        )
+        expect = np.lexsort((-k2, k1))
+        np.testing.assert_array_equal(k1[order], k1[expect])
+        np.testing.assert_array_equal(k2[order], k2[expect])
+
+
+class TestGroup:
+    def test_group_and_sum(self):
+        n, cap = 3000, 4096
+        keys = rng.integers(0, 37, cap).astype(np.int64)
+        vals = rng.integers(0, 1000, cap).astype(np.int64)
+        live = _live(n, cap)
+        order, gid, ng = K.group_rows([jnp.asarray(keys)], [None], live)
+        assert ng == len(np.unique(keys[:n]))
+        o = np.asarray(order)
+        sums = K.segment_reduce(
+            jnp.asarray(vals)[order],
+            gid,
+            live[order],
+            bucket_cap(ng),
+            "sum",
+        )
+        expect = {k: vals[:n][keys[:n] == k].sum() for k in np.unique(keys[:n])}
+        got_keys = keys[o[:n]][np.unique(np.asarray(gid)[:n], return_index=True)[1]]
+        for g, k in enumerate(sorted(expect)):
+            assert int(np.asarray(sums)[g]) == expect[k], (g, k)
+
+    def test_group_nulls_form_one_group(self):
+        n = cap = 1024
+        keys = rng.integers(0, 5, cap).astype(np.int64)
+        valid = rng.random(cap) < 0.7
+        order, gid, ng = K.group_rows(
+            [jnp.asarray(keys)], [jnp.asarray(valid)], _live(n, cap)
+        )
+        n_distinct = len(np.unique(keys[valid])) + (1 if (~valid).any() else 0)
+        assert ng == n_distinct
+
+    def test_min_max_count(self):
+        n = cap = 2048
+        keys = rng.integers(0, 10, cap).astype(np.int64)
+        vals = rng.normal(size=cap)
+        live = _live(n, cap)
+        order, gid, ng = K.group_rows([jnp.asarray(keys)], [None], live)
+        svals = jnp.asarray(vals)[order]
+        w = live[order]
+        mins = np.asarray(K.segment_reduce(svals, gid, w, bucket_cap(ng), "min"))
+        maxs = np.asarray(K.segment_reduce(svals, gid, w, bucket_cap(ng), "max"))
+        counts = np.asarray(K.segment_reduce(svals, gid, w, bucket_cap(ng), "count"))
+        o = np.asarray(order)
+        for g in range(ng):
+            k = keys[o[np.asarray(gid)[:n] == g][0]]
+            sel = vals[:n][keys[:n] == k]
+            assert mins[g] == pytest.approx(sel.min())
+            assert maxs[g] == pytest.approx(sel.max())
+            assert counts[g] == len(sel)
+
+
+class TestJoin:
+    def _join_np(self, lk, rk):
+        pairs = []
+        for i, k in enumerate(lk):
+            for j, k2 in enumerate(rk):
+                if k == k2:
+                    pairs.append((i, j))
+        return set(pairs)
+
+    def test_inner_join(self):
+        ln, lcap = 700, 1024
+        rn, rcap = 300, 512
+        lk = rng.integers(0, 100, lcap).astype(np.int64)
+        rk = rng.integers(0, 100, rcap).astype(np.int64)
+        li, ri, pl, total = K.join_candidates(
+            [jnp.asarray(lk)], [None], _live(ln, lcap),
+            [jnp.asarray(rk)], [None], _live(rn, rcap),
+        )
+        ok = K.verify_pairs(
+            li, ri, pl,
+            [jnp.asarray(lk)], [None], _live(ln, lcap),
+            [jnp.asarray(rk)], [None], _live(rn, rcap),
+        )
+        got = {
+            (int(a), int(b))
+            for a, b, m in zip(np.asarray(li), np.asarray(ri), np.asarray(ok))
+            if m
+        }
+        assert got == self._join_np(lk[:ln], rk[:rn])
+
+    def test_multi_key_join_with_nulls(self):
+        ln = lcap = 512
+        rn = rcap = 512
+        lk1 = rng.integers(0, 20, lcap).astype(np.int64)
+        lk2 = rng.integers(0, 5, lcap).astype(np.int64)
+        rk1 = rng.integers(0, 20, rcap).astype(np.int64)
+        rk2 = rng.integers(0, 5, rcap).astype(np.int64)
+        lv = rng.random(lcap) < 0.9
+        li, ri, pl, _ = K.join_candidates(
+            [jnp.asarray(lk1), jnp.asarray(lk2)], [jnp.asarray(lv), None], _live(ln, lcap),
+            [jnp.asarray(rk1), jnp.asarray(rk2)], [None, None], _live(rn, rcap),
+        )
+        ok = K.verify_pairs(
+            li, ri, pl,
+            [jnp.asarray(lk1), jnp.asarray(lk2)], [jnp.asarray(lv), None], _live(ln, lcap),
+            [jnp.asarray(rk1), jnp.asarray(rk2)], [None, None], _live(rn, rcap),
+        )
+        got = {
+            (int(a), int(b))
+            for a, b, m in zip(np.asarray(li), np.asarray(ri), np.asarray(ok))
+            if m
+        }
+        expect = {
+            (i, j)
+            for i in range(ln)
+            if lv[i]
+            for j in range(rn)
+            if lk1[i] == rk1[j] and lk2[i] == rk2[j]
+        }
+        assert got == expect
+
+    def test_semi_anti_mask(self):
+        ln = lcap = 256
+        rn = rcap = 128
+        lk = rng.integers(0, 400, lcap).astype(np.int64)
+        rk = rng.integers(0, 400, rcap).astype(np.int64)
+        li, ri, pl, _ = K.join_candidates(
+            [jnp.asarray(lk)], [None], _live(ln, lcap),
+            [jnp.asarray(rk)], [None], _live(rn, rcap),
+        )
+        ok = K.verify_pairs(
+            li, ri, pl,
+            [jnp.asarray(lk)], [None], _live(ln, lcap),
+            [jnp.asarray(rk)], [None], _live(rn, rcap),
+        )
+        present = np.asarray(K.matched_mask(li, ok, lcap))
+        expect = np.isin(lk, rk[:rn])
+        np.testing.assert_array_equal(present[:ln], expect[:ln])
+
+
+class TestWindow:
+    def test_running_position(self):
+        gid = jnp.asarray(np.array([0, 0, 0, 1, 1, 2, 3, 3, 3, 3], np.int32))
+        pos = np.asarray(K.running_position(gid))
+        np.testing.assert_array_equal(pos, [0, 1, 2, 0, 1, 0, 0, 1, 2, 3])
+
+    def test_segment_starts(self):
+        gid = jnp.asarray(np.array([0, 0, 1, 1, 1, 2], np.int32))
+        s = np.asarray(K.segment_starts(gid, 4))
+        np.testing.assert_array_equal(s[:3], [0, 2, 5])

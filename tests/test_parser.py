@@ -1,0 +1,179 @@
+"""SQL parser tests over representative TPC-DS query shapes."""
+
+import pytest
+
+from nds_tpu.engine import expr as E
+from nds_tpu.engine.sql import ast as A
+from nds_tpu.engine.sql.parser import parse_sql, parse_script
+
+
+def test_simple_select():
+    s = parse_sql("select a, b as x from t where a > 1 order by x limit 10")
+    assert isinstance(s, A.SelectStmt)
+    assert len(s.select_items) == 2
+    assert s.select_items[1][1] == "x"
+    assert s.limit == 10
+    assert isinstance(s.where, E.BinOp)
+
+
+def test_q3_shape():
+    sql = """
+    select dt.d_year, item.i_brand_id brand_id, item.i_brand brand,
+           sum(ss_ext_sales_price) sum_agg
+    from date_dim dt, store_sales, item
+    where dt.d_date_sk = store_sales.ss_sold_date_sk
+      and store_sales.ss_item_sk = item.i_item_sk
+      and item.i_manufact_id = 128
+      and dt.d_moy = 11
+    group by dt.d_year, item.i_brand, item.i_brand_id
+    order by dt.d_year, sum_agg desc, brand_id
+    limit 100
+    """
+    s = parse_sql(sql)
+    assert len(s.from_items) == 3
+    assert len(s.group_by) == 3
+    assert s.order_by[1].ascending is False
+    agg = s.select_items[3][0]
+    assert isinstance(agg, E.Agg) and agg.fn == "sum"
+
+
+def test_cte_and_union():
+    sql = """
+    with a as (select 1 x), b as (select 2 x)
+    select x from a union all select x from b order by x
+    """
+    s = parse_sql(sql)
+    assert len(s.ctes) == 2
+    assert s.set_ops[0][0] == "union all"
+
+
+def test_explicit_join():
+    s = parse_sql(
+        "select * from t1 left outer join t2 on t1.a = t2.b join t3 on t3.c = t1.a"
+    )
+    j = s.from_items[0]
+    assert isinstance(j, A.JoinClause)
+    assert j.kind == "inner"
+    assert j.left.kind == "left"
+
+
+def test_case_between_in_like():
+    sql = """
+    select case when a between 1 and 2 then 'lo'
+                when a in (3,4,5) then 'mid'
+                else 'hi' end c
+    from t where s like 'a%' and s not like '%z'
+    """
+    s = parse_sql(sql)
+    c = s.select_items[0][0]
+    assert isinstance(c, E.Case)
+    assert isinstance(c.branches[0][0], E.Between)
+    assert isinstance(c.branches[1][0], E.InList)
+    assert isinstance(s.where.right, E.Like) and s.where.right.negated
+
+
+def test_subqueries():
+    sql = """
+    select * from t where a in (select x from u)
+      and b > (select avg(y) from v)
+      and exists (select 1 from w where w.k = t.k)
+    """
+    s = parse_sql(sql)
+    conj = s.where
+    assert isinstance(conj.right, E.SubqueryExpr) and conj.right.kind == "exists"
+
+
+def test_interval_arith():
+    s = parse_sql(
+        "select * from d where d_date between cast('1999-02-22' as date) "
+        "and (cast('1999-02-22' as date) + interval 30 days)"
+    )
+    b = s.where
+    assert isinstance(b, E.Between)
+    assert isinstance(b.high, E.Func) and b.high.name == "date_add"
+
+
+def test_rollup_having():
+    sql = """
+    select i_category, avg(ss_net_profit) p from store_sales, item
+    where ss_item_sk = i_item_sk
+    group by rollup(i_category, i_class)
+    having avg(ss_net_profit) > 0
+    """
+    s = parse_sql(sql)
+    assert s.rollup and len(s.group_by) == 2
+    assert isinstance(s.having, E.BinOp)
+
+
+def test_window_function():
+    sql = """
+    select i_category,
+      sum(ss_sales_price) over (partition by i_category order by d_date
+                                rows between unbounded preceding and current row) csum,
+      rank() over (order by sum(ss_net_profit) desc) rk
+    from x
+    """
+    s = parse_sql(sql)
+    w = s.select_items[1][0]
+    assert isinstance(w, E.WindowFn) and w.fn == "sum"
+    assert w.frame == (("unbounded", "preceding"), ("current", None))
+    rk = s.select_items[2][0]
+    assert rk.fn == "rank" and rk.order_by[0][1] is False
+
+
+def test_count_distinct_and_star():
+    s = parse_sql("select count(*) c, count(distinct cd_gender) g from t")
+    c = s.select_items[0][0]
+    g = s.select_items[1][0]
+    assert c.fn == "count" and c.arg is None
+    assert g.distinct
+
+
+def test_insert_delete_ddl():
+    stmts = parse_script(
+        """
+        create temp view v as select * from t;
+        insert into fact select * from v;
+        delete from fact where d_sk between 10 and 20;
+        drop view v;
+        call spark_catalog.system.rollback_to_timestamp('tbl', '2020-01-01');
+        """
+    )
+    kinds = [type(x).__name__ for x in stmts]
+    assert kinds == [
+        "CreateViewStmt",
+        "InsertStmt",
+        "DeleteStmt",
+        "DropViewStmt",
+        "CallStmt",
+    ]
+
+
+def test_intersect():
+    s = parse_sql("select a from t1 intersect select a from t2")
+    assert s.set_ops[0][0] == "intersect"
+
+
+def test_decimal_literal():
+    s = parse_sql("select * from t where p > 1.25")
+    lit = s.where.right
+    assert lit.dtype.is_decimal and lit.dtype.scale == 2
+
+
+def test_nested_parens_from():
+    s = parse_sql(
+        "select * from (select a from t) x, (select b from u) y where x.a = y.b"
+    )
+    assert isinstance(s.from_items[0], A.SubqueryRef)
+    assert s.from_items[0].alias == "x"
+
+
+def test_substring_variants():
+    s = parse_sql("select substr(s, 1, 2), substring(s, 1, 3) from t")
+    assert s.select_items[0][0].name == "substr"
+    assert s.select_items[1][0].name == "substr"
+
+
+def test_quoted_identifiers():
+    s = parse_sql('select `weird col`, "other col" from t')
+    assert s.select_items[0][0].name == "weird col"
