@@ -1,0 +1,896 @@
+"""Binder/analyzer: SQL AST -> logical plan.
+
+Responsibilities: name resolution over nested scopes, `*` expansion, CTE
+registration (shared-identity plans so multiply-referenced CTEs materialize
+once), predicate classification (pushdown / equi-join edges / residual),
+subquery transformation (uncorrelated scalar -> cached broadcast; IN/EXISTS ->
+semi/anti join; correlated scalar -> group-aggregate + left join, the standard
+decorrelation for TPC-DS q1-style subqueries), aggregate/window extraction and
+post-aggregation expression rewriting, ROLLUP grouping sets.
+
+Counterpart of Spark Catalyst's analyzer, which the reference relies on via
+`spark.sql(...)` (reference: nds/nds_power.py:125-135).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from . import expr as E
+from . import plan as P
+from .sql import ast as A
+
+
+class BindError(Exception):
+    pass
+
+
+class Relation:
+    """A FROM-item bound to a plan: output columns are qualified names."""
+
+    def __init__(self, plan, alias, columns):
+        self.plan = plan
+        self.alias = alias  # may be None for joined compounds
+        self.columns = columns  # list of (qualified_name, bare_name, rel_alias)
+
+    def find(self, name, qualifier=None):
+        out = []
+        for qn, bare, ra in self.columns:
+            if bare == name and (qualifier is None or ra == qualifier):
+                out.append(qn)
+        return out
+
+
+class Scope:
+    def __init__(self, relations, parent=None, aliases=None):
+        self.relations = relations  # list[Relation]
+        self.parent = parent
+        self.aliases = aliases or {}  # select-item alias -> Expr
+
+    def resolve(self, name, qualifier=None):
+        """Returns (qualified_name, is_outer)."""
+        hits = []
+        for r in self.relations:
+            hits += r.find(name, qualifier)
+        if len(hits) == 1:
+            return hits[0], False
+        if len(hits) > 1:
+            # same qualified name reachable through several compound relations
+            if all(h == hits[0] for h in hits):
+                return hits[0], False
+            raise BindError(f"ambiguous column {qualifier+'.' if qualifier else ''}{name}: {hits}")
+        if self.parent is not None:
+            qn, _ = self.parent.resolve(name, qualifier)
+            return qn, True
+        raise BindError(f"cannot resolve column {qualifier+'.' if qualifier else ''}{name}")
+
+
+class Binder:
+    def __init__(self, catalog):
+        self.catalog = catalog  # object with .schema(name) -> Schema | None
+        self._counter = 0
+        self._cte_plans = {}  # name -> (plan, columns) registered per bind
+
+    def fresh(self, prefix="_c"):
+        self._counter += 1
+        return f"{prefix}{self._counter}"
+
+    # ------------------------------------------------------------------
+    def bind(self, stmt: A.SelectStmt) -> P.PlanNode:
+        plan, _cols = self.bind_select(stmt, None, {})
+        return plan
+
+    # ------------------------------------------------------------------
+    def bind_select(self, stmt: A.SelectStmt, outer: Optional[Scope], views):
+        """Returns (plan, out_columns [(out_name, alias)])."""
+        views = dict(views)
+        for name, sub in stmt.ctes:
+            sub_plan, sub_cols = self.bind_select(sub, None, views)
+            views[name.lower()] = (sub_plan, sub_cols)
+
+        plan, cols = self._bind_core(stmt, outer, views)
+
+        for op, rhs in stmt.set_ops:
+            rplan, rcols = (
+                self.bind_select(rhs, outer, views)
+                if (rhs.ctes or rhs.set_ops)
+                else self._bind_core(rhs, outer, views)
+            )
+            if len(rcols) != len(cols):
+                raise BindError("set operation column count mismatch")
+            # align rhs output names to lhs
+            rplan = P.Project(
+                [(E.Col(rn), ln) for (rn, _), (ln, _) in zip(rcols, cols)], rplan
+            )
+            kind = {"union all": "union_all", "union": "union",
+                    "intersect": "intersect", "except": "except"}[op]
+            plan = P.SetOp(kind, plan, rplan)
+
+        if stmt.set_ops and (stmt.order_by or stmt.limit is not None):
+            # outer ORDER BY binds to the unioned output columns
+            out_aliases = {a: E.Col(n) for n, a in cols if a}
+            for n, a in cols:
+                out_aliases.setdefault(n, E.Col(n))
+            if stmt.order_by:
+                skeys = []
+                for it in stmt.order_by:
+                    e = it.expr
+                    if isinstance(e, E.Lit) and isinstance(e.value, int):
+                        e = E.Col(cols[e.value - 1][0])
+                    elif isinstance(e, E.Col) and e.table is None and e.name in out_aliases:
+                        e = out_aliases[e.name]
+                    else:
+                        e = self._bind_expr(e, Scope([Relation(None, None, [(n, a or n, None) for n, a in cols])]), views)
+                    skeys.append((e, it.ascending, it.nulls_first))
+                plan = P.Sort(skeys, plan)
+            if stmt.limit is not None:
+                plan = P.Limit(stmt.limit, plan)
+        return plan, cols
+
+    # ------------------------------------------------------------------
+    def _bind_core(self, stmt: A.SelectStmt, outer, views):
+        # 1. FROM
+        relations = []
+        if stmt.from_items:
+            for item in stmt.from_items:
+                relations.append(self._bind_from_item(item, outer, views))
+        else:
+            # FROM-less SELECT: single-row dummy relation
+            relations.append(Relation(P.MaterializedScan("__dual__"), "__dual__", []))
+        scope = Scope(relations, outer)
+
+        # 2. WHERE classification
+        filters_per_rel = {i: [] for i in range(len(relations))}
+        edges = []
+        residual = []
+        post_join_subqueries = []  # (kind, ...) applied after MultiJoin
+        if stmt.where is not None:
+            for conj in _conjuncts(stmt.where):
+                self._classify_conjunct(
+                    conj, scope, relations, views,
+                    filters_per_rel, edges, residual, post_join_subqueries,
+                )
+
+        # 3. assemble join tree
+        rel_plans = []
+        for i, r in enumerate(relations):
+            p = r.plan
+            preds = filters_per_rel[i]
+            if preds:
+                p = P.Filter(_conjoin(preds), p)
+            rel_plans.append(p)
+        if len(rel_plans) == 1 and not edges:
+            base = rel_plans[0]
+        else:
+            base = P.MultiJoin(rel_plans, edges, None)
+        if residual:
+            base = P.Filter(_conjoin(residual), base)
+        # semi/anti/scalar-correlated joins after the main join
+        for entry in post_join_subqueries:
+            base = entry(base)
+
+        # 4. select items: expand *, name them
+        items = []  # (raw Expr (bound), out_name, alias_for_user)
+        for sexpr, alias in stmt.select_items:
+            if sexpr == "*":
+                qual = alias  # ('*', qualifier) packs qualifier in alias slot
+                for r in relations:
+                    for qn, bare, ra in r.columns:
+                        if qual is None or ra == qual:
+                            items.append((E.Col(qn), bare))
+            else:
+                bound = self._bind_expr(sexpr, scope, views)
+                items.append((bound, alias))
+        named_items = []
+        for bound, alias in items:
+            if alias is None:
+                if isinstance(bound, E.Col):
+                    alias = bound.name.split(".")[-1]
+                else:
+                    alias = self.fresh("_c")
+            named_items.append((bound, alias))
+        scope.aliases = {a: e for e, a in named_items}
+
+        having = (
+            self._bind_expr(stmt.having, scope, views)
+            if stmt.having is not None
+            else None
+        )
+        order_exprs = []
+        for it in stmt.order_by:
+            e = it.expr
+            if isinstance(e, E.Lit) and isinstance(e.value, int):
+                e = named_items[e.value - 1][0]
+            elif (
+                isinstance(e, E.Col)
+                and e.table is None
+                and e.name in scope.aliases
+            ):
+                e = scope.aliases[e.name]
+            else:
+                e = self._bind_expr(e, scope, views)
+            order_exprs.append((e, it.ascending, it.nulls_first))
+
+        group_exprs = []
+        for g in stmt.group_by:
+            if isinstance(g, E.Lit) and isinstance(g.value, int):
+                group_exprs.append(named_items[g.value - 1][0])
+            elif isinstance(g, E.Col) and g.table is None:
+                # alias takes precedence only if not a real column
+                try:
+                    group_exprs.append(self._bind_expr(g, scope, views))
+                except BindError:
+                    if g.name in scope.aliases:
+                        group_exprs.append(scope.aliases[g.name])
+                    else:
+                        raise
+            else:
+                group_exprs.append(self._bind_expr(g, scope, views))
+
+        has_agg = (
+            bool(group_exprs)
+            or any(E.contains_agg(e) for e, _ in named_items)
+            or (having is not None and E.contains_agg(having))
+            or any(E.contains_agg(e) for e, _, _ in order_exprs)
+        )
+
+        if has_agg:
+            base, rewrite = self._plan_aggregate(
+                base, stmt, group_exprs, named_items, having, order_exprs
+            )
+            named_items = [(rewrite(e), a) for e, a in named_items]
+            having = rewrite(having) if having is not None else None
+            order_exprs = [(rewrite(e), asc, nf) for e, asc, nf in order_exprs]
+
+        if having is not None:
+            base = P.Filter(having, base)
+
+        # 5. window functions (evaluated over the post-agg relation)
+        win_fns = []
+
+        def extract_windows(e):
+            if isinstance(e, E.WindowFn):
+                for wf, nm in win_fns:
+                    if wf == e:
+                        return E.Col(nm)
+                nm = self.fresh("_w")
+                win_fns.append((e, nm))
+                return E.Col(nm)
+            return _rewrite_children(e, extract_windows)
+
+        named_items = [(extract_windows(e), a) for e, a in named_items]
+        order_exprs = [(extract_windows(e), asc, nf) for e, asc, nf in order_exprs]
+        if win_fns:
+            base = P.Window(win_fns, base)
+
+        # 6. projection (+ hidden sort keys), distinct, sort, limit, prune
+        proj_items = []
+        out_cols = []
+        used = set()
+        for e, a in named_items:
+            out = a
+            while out in used:
+                out = self.fresh(a + "_")
+            used.add(out)
+            proj_items.append((e, out))
+            out_cols.append((out, a))
+        sort_keys = []
+        for e, asc, nf in order_exprs:
+            found = None
+            for pe, on in proj_items:
+                if pe == e:
+                    found = on
+                    break
+            if found is None:
+                hn = self.fresh("_s")
+                proj_items.append((e, hn))
+                found = hn
+            sort_keys.append((E.Col(found), asc, nf))
+
+        plan = P.Project(proj_items, base)
+        if stmt.distinct:
+            plan = P.Distinct(plan)
+        if sort_keys and not stmt.set_ops:
+            plan = P.Sort(sort_keys, plan)
+        if len(proj_items) > len(out_cols):
+            plan = P.Project(
+                [(E.Col(on), on) for on, _ in out_cols], plan
+            )
+        if stmt.limit is not None and not stmt.set_ops:
+            plan = P.Limit(stmt.limit, plan)
+        return plan, out_cols
+
+    # ------------------------------------------------------------------
+    def _plan_aggregate(self, base, stmt, group_exprs, named_items, having, order_exprs):
+        keys = []
+        for g in group_exprs:
+            keys.append((g, self.fresh("_g")))
+        aggs = []
+
+        def collect(e):
+            if isinstance(e, E.Agg):
+                for ag, nm in aggs:
+                    if ag == e:
+                        return
+                aggs.append((e, self.fresh("_a")))
+                return
+            for c in e.children():
+                collect(c)
+
+        for e, _ in named_items:
+            collect(e)
+        if having is not None:
+            collect(having)
+        for e, _, _ in order_exprs:
+            collect(e)
+        for e in [e for e, _ in named_items]:
+            for w in E.walk(e):
+                if isinstance(w, E.WindowFn):
+                    for c in w.children():
+                        collect(c)
+
+        grouping_sets = None
+        if stmt.rollup:
+            grouping_sets = [list(range(k)) for k in range(len(keys), -1, -1)]
+        elif stmt.grouping_sets is not None:
+            # map each raw set member onto the bound group key by structure
+            grouping_sets = []
+            for s in stmt.grouping_sets:
+                idxs = []
+                for e in s:
+                    for i, g in enumerate(group_exprs):
+                        if self._structurally_same(e, g):
+                            idxs.append(i)
+                            break
+                grouping_sets.append(idxs)
+
+        node = P.Aggregate(keys, aggs, base, grouping_sets)
+
+        def rewrite(e):
+            if e is None:
+                return None
+            for g, nm in keys:
+                if e == g:
+                    return E.Col(nm)
+            if isinstance(e, E.Agg):
+                for ag, nm in aggs:
+                    if ag == e:
+                        return E.Col(nm)
+                raise BindError(f"unregistered aggregate {e}")
+            if isinstance(e, E.WindowFn):
+                return dataclasses.replace(
+                    e,
+                    arg=rewrite(e.arg) if e.arg is not None else None,
+                    partition_by=tuple(rewrite(x) for x in e.partition_by),
+                    order_by=tuple((rewrite(x), asc) for x, asc in e.order_by),
+                )
+            if isinstance(e, E.Col):
+                raise BindError(
+                    f"column {e} is neither grouped nor aggregated"
+                )
+            return _rewrite_children(e, rewrite)
+
+        return node, rewrite
+
+    def _structurally_same(self, raw, bound):
+        # grouping-set member exprs are simple columns in TPC-DS; compare by
+        # terminal name
+        if isinstance(raw, E.Col) and isinstance(bound, E.Col):
+            return bound.name.split(".")[-1] == raw.name or bound.name == raw.name
+        return raw == bound
+
+    # ------------------------------------------------------------------
+    def _bind_from_item(self, item, outer, views) -> Relation:
+        if isinstance(item, A.TableRef):
+            name = item.name.lower()
+            alias = item.alias or name
+            if name in views:
+                vplan, vcols = views[name]
+                cols = [(qn, a, alias) for qn, a in vcols]
+                # re-qualify through a projection so alias.col resolves
+                proj = P.Project(
+                    [(E.Col(qn), f"{alias}.{a}") for qn, a in vcols], vplan
+                )
+                return Relation(
+                    proj, alias, [(f"{alias}.{a}", a, alias) for _, a in vcols]
+                )
+            schema = self.catalog.schema(name)
+            if schema is None:
+                raise BindError(f"unknown table {item.name}")
+            cols = [(f"{alias}.{f.name}", f.name, alias) for f in schema]
+            return Relation(P.Scan(name, alias), alias, cols)
+        if isinstance(item, A.SubqueryRef):
+            sub_plan, sub_cols = self.bind_select(item.query, outer, views)
+            alias = item.alias
+            proj = P.Project(
+                [(E.Col(on), f"{alias}.{a}") for on, a in sub_cols], sub_plan
+            )
+            return Relation(
+                proj, alias, [(f"{alias}.{a}", a, alias) for _, a in sub_cols]
+            )
+        if isinstance(item, A.JoinClause):
+            return self._bind_join_clause(item, outer, views)
+        raise BindError(f"unsupported FROM item {item}")
+
+    def _bind_join_clause(self, jc: A.JoinClause, outer, views) -> Relation:
+        left = self._bind_from_item(jc.left, outer, views)
+        right = self._bind_from_item(jc.right, outer, views)
+        scope = Scope([left, right], outer)
+        lcols = {qn for qn, _, _ in left.columns}
+        rcols = {qn for qn, _, _ in right.columns}
+        lkeys, rkeys, residual = [], [], []
+        if jc.on is not None:
+            cond = self._bind_expr(jc.on, scope, views)
+            for conj in _conjuncts(cond):
+                side_l = _refs(conj) & lcols
+                side_r = _refs(conj) & rcols
+                if (
+                    isinstance(conj, E.BinOp)
+                    and conj.op == "="
+                    and side_l
+                    and side_r
+                ):
+                    le, re_ = conj.left, conj.right
+                    if _refs(le) <= lcols and _refs(re_) <= rcols:
+                        lkeys.append(le)
+                        rkeys.append(re_)
+                        continue
+                    if _refs(le) <= rcols and _refs(re_) <= lcols:
+                        lkeys.append(re_)
+                        rkeys.append(le)
+                        continue
+                residual.append(conj)
+            res = _conjoin(residual) if residual else None
+        else:
+            res = None
+        kind = jc.kind
+        node = P.Join(kind, left.plan, right.plan, lkeys, rkeys, res)
+        cols = list(left.columns) + (
+            [] if kind in ("semi", "anti") else list(right.columns)
+        )
+        return Relation(node, None, cols)
+
+    # ------------------------------------------------------------------
+    def _classify_conjunct(
+        self, conj, scope, relations, views,
+        filters_per_rel, edges, residual, post_join,
+    ):
+        # subquery predicates
+        subs = [x for x in E.walk(conj) if isinstance(x, E.SubqueryExpr)]
+        if subs:
+            if len(subs) == 1 and _is_simple_subquery_conjunct(conj, subs[0]):
+                post_join.append(
+                    self._plan_subquery_conjunct(conj, subs[0], scope, views)
+                )
+            else:
+                # subqueries under OR / multiple per conjunct (TPC-DS q10/q35
+                # `exists(...) or exists(...)`): mark joins compute a bool
+                # "has match" column per subquery, then the rewritten
+                # predicate filters on the marks
+                post_join.append(
+                    self._plan_marked_conjunct(conj, subs, scope, views)
+                )
+            return
+        bound = self._bind_expr(conj, scope, views)
+        refs = _refs(bound)
+        rel_sets = [
+            {qn for qn, _, _ in r.columns} for r in relations
+        ]
+        touching = [i for i, s in enumerate(rel_sets) if refs & s]
+        if len(touching) <= 1:
+            i = touching[0] if touching else 0
+            filters_per_rel[i].append(bound)
+            return
+        if (
+            isinstance(bound, E.BinOp)
+            and bound.op == "="
+            and len(touching) == 2
+        ):
+            i, j = touching
+            le, re_ = bound.left, bound.right
+            if _refs(le) <= rel_sets[i] and _refs(re_) <= rel_sets[j]:
+                edges.append((i, j, le, re_))
+                return
+            if _refs(le) <= rel_sets[j] and _refs(re_) <= rel_sets[i]:
+                edges.append((i, j, re_, le))
+                return
+        residual.append(bound)
+
+    # ------------------------------------------------------------------
+    def _plan_subquery_conjunct(self, conj, sub: E.SubqueryExpr, scope, views):
+        """Returns fn(base_plan) -> new_plan implementing the predicate."""
+        if sub.kind == "exists":
+            inner_plan, joins = self._bind_correlated(sub.query, scope, views)
+            kind = "anti" if _under_not(conj, sub) else "semi"
+            lkeys = [o for o, _ in joins]
+            rkeys = [i for _, i in joins]
+            return lambda base: P.Join(kind, base, inner_plan, lkeys, rkeys)
+        if sub.kind == "in":
+            operand = self._bind_expr(sub.operand, scope, views)
+            inner_plan, joins = self._bind_correlated(
+                sub.query, scope, views
+            )
+            sub_cols = self._subquery_out_cols
+            kind = "anti" if (sub.negated or _under_not(conj, sub)) else "semi"
+            lkeys = [operand] + [o for o, _ in joins]
+            rkeys = [E.Col(sub_cols[0][0])] + [i for _, i in joins]
+            return lambda base: P.Join(kind, base, inner_plan, lkeys, rkeys)
+        if sub.kind == "scalar":
+            # conj is CMP(expr, subquery) possibly correlated
+            inner_plan, joins = self._bind_correlated(sub.query, scope, views)
+            sub_cols = self._subquery_out_cols
+            val_col = E.Col(sub_cols[0][0])
+            cmp = _replace_node(conj, sub, val_col)
+            cmp = self._bind_expr_partial(cmp, scope, views, skip={val_col.name})
+            if not joins:
+                # uncorrelated: broadcast scalar
+                sc = E.ScalarSubquery(plan=inner_plan, out_name=sub_cols[0][0])
+                cmp2 = _replace_node(cmp, val_col, sc)
+                return lambda base: P.Filter(cmp2, base)
+            lkeys = [o for o, _ in joins]
+            rkeys = [i for _, i in joins]
+
+            def apply(base):
+                j = P.Join("left", base, inner_plan, lkeys, rkeys)
+                return P.Filter(cmp, j)
+
+            return apply
+        raise BindError(f"unsupported subquery kind {sub.kind}")
+
+    def _plan_marked_conjunct(self, conj, subs, scope, views):
+        """Mark-join lowering for subqueries in arbitrary boolean context."""
+        mark_joins = []  # (inner_plan, lkeys, rkeys, mark_name)
+        rewritten = conj
+        marks = set()
+        for sub in subs:
+            if sub.kind == "scalar":
+                raise BindError(
+                    "correlated scalar subquery under OR is not supported"
+                )
+            inner_plan, joins = self._bind_correlated(sub.query, scope, views)
+            sub_cols = self._subquery_out_cols
+            mark = self.fresh("_m")
+            marks.add(mark)
+            lkeys = [o for o, _ in joins]
+            rkeys = [i for _, i in joins]
+            if sub.kind == "in":
+                operand = self._bind_expr(sub.operand, scope, views)
+                lkeys = [operand] + lkeys
+                rkeys = [E.Col(sub_cols[0][0])] + rkeys
+            repl = E.Col(mark)
+            if sub.kind == "in" and sub.negated:
+                repl = E.UnaryOp("not", repl)
+            rewritten = _replace_node(rewritten, sub, repl)
+            mark_joins.append((inner_plan, lkeys, rkeys, mark))
+        pred = self._bind_expr_partial(rewritten, scope, views, skip=marks)
+
+        def apply(base):
+            for inner_plan, lkeys, rkeys, mark in mark_joins:
+                base = P.Join(
+                    "mark", base, inner_plan, lkeys, rkeys, mark_name=mark
+                )
+            return P.Filter(pred, base)
+
+        return apply
+
+    def _bind_correlated(self, query: A.SelectStmt, scope, views):
+        """Bind a (possibly correlated) subquery.
+
+        Correlated equi-conjuncts referencing the outer scope are stripped
+        from the subquery and returned as join pairs (outer_expr, inner_col).
+        If the subquery is a scalar aggregate, the correlation columns become
+        its GROUP BY keys (classic decorrelation)."""
+        corr = []
+
+        sub_binder = _CorrelatedBinder(self, scope, corr, views)
+        plan, cols = sub_binder.run(query)
+        self._subquery_out_cols = cols
+        return plan, corr
+
+    # ------------------------------------------------------------------
+    # expression binding
+    def _bind_expr(self, e, scope: Scope, views):
+        return self._bind_expr_partial(e, scope, views, skip=set())
+
+    def _bind_expr_partial(self, e, scope, views, skip):
+        def rec(x):
+            if isinstance(x, E.Col):
+                if x.name in skip:
+                    return x
+                qn, _outer = scope.resolve(x.name, x.table)
+                return E.Col(qn)
+            if isinstance(x, E.SubqueryExpr):
+                if x.kind != "scalar":
+                    raise BindError(
+                        "IN/EXISTS subquery only supported in WHERE conjuncts"
+                    )
+                inner_plan, joins = self._bind_correlated(x.query, scope, views)
+                if joins:
+                    raise BindError(
+                        "correlated scalar subquery only supported as a "
+                        "WHERE comparison"
+                    )
+                cols = self._subquery_out_cols
+                return E.ScalarSubquery(plan=inner_plan, out_name=cols[0][0])
+            if isinstance(x, E.ScalarSubquery):
+                return x
+            return _rewrite_children(x, rec)
+
+        return rec(e)
+
+
+class _CorrelatedBinder:
+    """Binds a subquery, stripping outer-referencing equi-conjuncts into
+    correlation join pairs; adds correlation columns to GROUP BY for scalar
+    aggregate subqueries."""
+
+    def __init__(self, binder: Binder, outer_scope: Scope, corr_out: list, views=None):
+        self.binder = binder
+        self.outer = outer_scope
+        self.corr = corr_out
+        self.views = views or {}
+
+    def run(self, query: A.SelectStmt):
+        q = dataclasses.replace(query)
+        # Pre-scan WHERE conjuncts for outer references
+        inner_probe, _ = _probe_scope(self.binder, q, self.outer, self.views)
+        kept = []
+        corr_inner_exprs = []
+        if q.where is not None:
+            for conj in _conjuncts(q.where):
+                pair = self._try_correlated_equi(conj, inner_probe)
+                if pair is not None:
+                    outer_e, inner_e = pair
+                    self.corr.append((outer_e, inner_e))
+                    corr_inner_exprs.append(inner_e)
+                else:
+                    kept.append(conj)
+            q.where = _conjoin_ast(kept)
+        if self.corr and _is_scalar_agg(q):
+            # group the aggregate by the correlation keys
+            q = dataclasses.replace(q, group_by=list(q.group_by))
+            plan, cols = self._bind_grouped_scalar(q, corr_inner_exprs)
+            return plan, cols
+        if self.corr:
+            # expose the inner correlation keys through the subquery's own
+            # projection (binding them in the subquery scope, where they
+            # resolve correctly)
+            binder = self.binder
+            key_aliases = [binder.fresh("_ck") for _ in corr_inner_exprs]
+            q = dataclasses.replace(
+                q,
+                select_items=list(q.select_items)
+                + [(e, a) for e, a in zip(corr_inner_exprs, key_aliases)],
+            )
+            plan, cols = binder.bind_select(q, self.outer, self.views)
+            nk = len(corr_inner_exprs)
+            val_cols, key_cols = cols[:-nk], cols[-nk:]
+            self.corr[:] = [
+                (o, E.Col(kc[0])) for (o, _), kc in zip(self.corr, key_cols)
+            ]
+            binder._subquery_out_cols = val_cols
+            return plan, val_cols
+        plan, cols = self.binder.bind_select(q, self.outer, self.views)
+        return plan, cols
+
+    def _bind_grouped_scalar(self, q, corr_inner_exprs):
+        binder = self.binder
+        # bind the scalar aggregate subquery with corr keys added as group
+        # keys and projected out
+        plan, cols = binder.bind_select(
+            dataclasses.replace(
+                q,
+                select_items=list(q.select_items)
+                + [(e, binder.fresh("_ck")) for e in corr_inner_exprs],
+                group_by=list(q.group_by) + list(corr_inner_exprs),
+            ),
+            None,
+            self.views,
+        )
+        n_keys = len(corr_inner_exprs)
+        val_cols = cols[:-n_keys] if n_keys else cols
+        key_cols = cols[-n_keys:] if n_keys else []
+        self.corr[:] = [
+            (o, E.Col(kc[0])) for (o, _), kc in zip(self.corr, key_cols)
+        ]
+        self.binder._subquery_out_cols = val_cols
+        return plan, val_cols
+
+    def _try_correlated_equi(self, conj, inner_probe):
+        """If conj is outer_expr = inner_expr, return (bound_outer, raw_inner)."""
+        if not (isinstance(conj, E.BinOp) and conj.op == "="):
+            return None
+        for a, b in ((conj.left, conj.right), (conj.right, conj.left)):
+            if not isinstance(a, E.Col):
+                continue
+            try:
+                inner_probe.resolve(a.name, a.table)
+                continue  # resolves internally -> not an outer ref
+            except BindError:
+                pass
+            try:
+                qn, _ = self.outer.resolve(a.name, a.table)
+            except BindError:
+                continue
+            return (E.Col(qn), b)
+        return None
+
+
+def _probe_scope(binder, q, outer, views=None):
+    """Build a name-resolution-only scope for the subquery's FROM items."""
+    views = views or {}
+    rels = []
+    for item in q.from_items:
+        if isinstance(item, A.TableRef):
+            name = item.name.lower()
+            alias = item.alias or name
+            if name in views:
+                _vplan, vcols = views[name]
+                rels.append(
+                    Relation(None, alias, [(f"{alias}.{a}", a, alias) for _, a in vcols])
+                )
+                continue
+            schema = binder.catalog.schema(name)
+            if schema is None:
+                rels.append(Relation(None, alias, []))
+            else:
+                rels.append(
+                    Relation(
+                        None,
+                        alias,
+                        [(f"{alias}.{f.name}", f.name, alias) for f in schema],
+                    )
+                )
+        elif isinstance(item, A.JoinClause):
+            stack = [item]
+            flat = []
+            while stack:
+                it = stack.pop()
+                if isinstance(it, A.JoinClause):
+                    stack += [it.left, it.right]
+                else:
+                    flat.append(it)
+            for t in flat:
+                if isinstance(t, A.TableRef):
+                    name = t.name.lower()
+                    alias = t.alias or name
+                    schema = binder.catalog.schema(name)
+                    if schema is not None:
+                        rels.append(
+                            Relation(
+                                None,
+                                alias,
+                                [
+                                    (f"{alias}.{f.name}", f.name, alias)
+                                    for f in schema
+                                ],
+                            )
+                        )
+        elif isinstance(item, A.SubqueryRef):
+            # approximate: output columns from its select list aliases
+            cols = []
+            for e, a in item.query.select_items:
+                if a:
+                    cols.append((f"{item.alias}.{a}", a, item.alias))
+                elif isinstance(e, E.Col):
+                    cols.append((f"{item.alias}.{e.name}", e.name, item.alias))
+            rels.append(Relation(None, item.alias, cols))
+    return Scope(rels, None), rels
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _conjuncts(e):
+    if isinstance(e, E.BinOp) and e.op == "and":
+        return _conjuncts(e.left) + _conjuncts(e.right)
+    return [e]
+
+
+def _conjoin(preds):
+    out = preds[0]
+    for p in preds[1:]:
+        out = E.BinOp("and", out, p)
+    return out
+
+
+def _conjoin_ast(preds):
+    if not preds:
+        return None
+    return _conjoin(preds)
+
+
+def _refs(e):
+    return {x.name for x in E.walk(e) if isinstance(x, E.Col)}
+
+
+def _rewrite_children(e, fn):
+    if isinstance(e, E.BinOp):
+        return E.BinOp(e.op, fn(e.left), fn(e.right))
+    if isinstance(e, E.UnaryOp):
+        return E.UnaryOp(e.op, fn(e.operand))
+    if isinstance(e, E.Between):
+        return E.Between(fn(e.operand), fn(e.low), fn(e.high), e.negated)
+    if isinstance(e, E.InList):
+        return E.InList(fn(e.operand), e.values, e.negated)
+    if isinstance(e, E.Like):
+        return E.Like(fn(e.operand), e.pattern, e.negated)
+    if isinstance(e, E.Case):
+        return E.Case(
+            tuple((fn(c), fn(v)) for c, v in e.branches),
+            fn(e.default) if e.default is not None else None,
+        )
+    if isinstance(e, E.Cast):
+        return E.Cast(fn(e.operand), e.target)
+    if isinstance(e, E.Func):
+        return E.Func(e.name, tuple(fn(a) for a in e.args))
+    if isinstance(e, E.Agg):
+        return E.Agg(e.fn, fn(e.arg) if e.arg is not None else None, e.distinct)
+    if isinstance(e, E.WindowFn):
+        return E.WindowFn(
+            e.fn,
+            fn(e.arg) if e.arg is not None else None,
+            tuple(fn(x) for x in e.partition_by),
+            tuple((fn(x), asc) for x, asc in e.order_by),
+            e.frame,
+        )
+    return e
+
+
+def _is_simple_subquery_conjunct(conj, sub):
+    """True when replacing the whole conjunct by a join is semantics-preserving:
+    the subquery is the entire conjunct (under optional NOT) for EXISTS/IN,
+    or any shape for scalar (the scalar path filters the full rewritten
+    predicate, so OR contexts stay correct)."""
+    if sub.kind == "scalar":
+        return True
+    e = conj
+    while isinstance(e, E.UnaryOp) and e.op == "not":
+        e = e.operand
+    return e is sub
+
+
+def _find_subquery(e):
+    for x in E.walk(e):
+        if isinstance(x, E.SubqueryExpr):
+            return x
+    return None
+
+
+def _under_not(conj, sub):
+    """True if the subquery appears under a NOT (NOT EXISTS ...)."""
+    def rec(e, neg):
+        if e is sub:
+            return neg
+        if isinstance(e, E.UnaryOp) and e.op == "not":
+            return rec(e.operand, not neg)
+        for c in e.children():
+            r = rec(c, neg)
+            if r is not None:
+                return r
+        return None
+
+    r = rec(conj, False)
+    return bool(r)
+
+
+def _replace_node(e, target, replacement):
+    if e is target or e == target:
+        return replacement
+
+    def fn(x):
+        return _replace_node(x, target, replacement)
+
+    return _rewrite_children(e, fn)
+
+
+def _is_scalar_agg(q: A.SelectStmt) -> bool:
+    return (
+        len(q.select_items) == 1
+        and q.select_items[0][0] != "*"
+        and E.contains_agg(q.select_items[0][0])
+        and not q.group_by
+    )

@@ -199,6 +199,15 @@ class SubqueryExpr(Expr):
     negated: bool = False
 
 
+@dataclass(frozen=True)
+class ScalarSubquery(Expr):
+    """Bound uncorrelated scalar subquery: the executor runs `plan` once
+    (cached by identity) and broadcasts the single value."""
+
+    plan: object = field(hash=False, compare=False, default=None)
+    out_name: str = ""
+
+
 def walk(e: Expr):
     yield e
     for c in e.children():
@@ -340,6 +349,19 @@ class Evaluator:
             return self._compare(op, a, b, valid)
         if a.dtype.is_string or b.dtype.is_string:
             raise TypeError(f"arith {op} on strings")
+        if op == "*" and (a.dtype.is_decimal or b.dtype.is_decimal):
+            # products multiply *unscaled* operands: scale(s1)*scale(s2) ->
+            # scale s1+s2 (the common-scale alignment of _numeric_pair is
+            # only right for +/-/compare, and would waste two multiplies)
+            s1 = a.dtype.scale if a.dtype.is_decimal else 0
+            s2 = b.dtype.scale if b.dtype.is_decimal else 0
+            if a.dtype.kind == "float64" or b.dtype.kind == "float64":
+                fa = a.data.astype(jnp.float64) / 10**s1
+                fb = b.data.astype(jnp.float64) / 10**s2
+                return Column(fa * fb, FLOAT64, valid)
+            da = a.data.astype(jnp.int64)
+            db = b.data.astype(jnp.int64)
+            return Column(da * db, DType("decimal", 38, s1 + s2), valid)
         xa, xb, dt = self._numeric_pair(a, b)
         if op == "+":
             return Column(xa + xb, dt, valid)
@@ -347,16 +369,6 @@ class Evaluator:
             dtr = INT32 if dt.kind == "date" else dt
             return Column(xa - xb, dtr, valid)
         if op == "*":
-            if dt.is_decimal:
-                # decimal*decimal: result scale = s1+s2 (we keep operands at
-                # their own scales for the product, so recompute directly)
-                a2, b2 = self.eval(e.left), self.eval(e.right)
-                if a2.dtype.is_decimal and b2.dtype.is_decimal:
-                    s = a2.dtype.scale + b2.dtype.scale
-                    return Column(
-                        a2.data * b2.data, DType("decimal", 38, s), valid
-                    )
-                return Column(xa * xb, dt, valid)
             return Column(xa * xb, dt, valid)
         if op == "/":
             fa = xa.astype(jnp.float64)

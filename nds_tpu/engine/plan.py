@@ -1,0 +1,167 @@
+"""Logical/physical plan IR.
+
+The binder lowers SQL AST into this tree; the executor interprets it over
+device Tables. Column identity is by unique string name ("alias.col" for base
+columns, binder-generated names for derived ones), so plans carry no separate
+symbol table.
+
+This is the engine's counterpart of the Catalyst plans the reference submits
+to Spark (reference: nds/nds_power.py:125-135 `spark.sql(query)`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from . import expr as E
+
+
+@dataclass
+class PlanNode:
+    def children(self):
+        return []
+
+
+@dataclass
+class Scan(PlanNode):
+    table: str  # catalog name
+    alias: str  # column prefix in the output
+    columns: list = None  # projection pushdown: subset of base columns or None
+
+
+@dataclass
+class Project(PlanNode):
+    items: list  # (Expr, out_name)
+    child: PlanNode = None
+
+    def children(self):
+        return [self.child]
+
+
+@dataclass
+class Filter(PlanNode):
+    predicate: E.Expr
+    child: PlanNode = None
+
+    def children(self):
+        return [self.child]
+
+
+@dataclass
+class Join(PlanNode):
+    kind: str  # inner | left | right | full | semi | anti | cross | mark
+    left: PlanNode = None
+    right: PlanNode = None
+    left_keys: list = field(default_factory=list)  # Exprs over left
+    right_keys: list = field(default_factory=list)  # Exprs over right
+    residual: Optional[E.Expr] = None  # non-equi condition applied post-match
+    mark_name: Optional[str] = None  # kind == "mark": bool "has a match" column
+
+    def children(self):
+        return [self.left, self.right]
+
+
+@dataclass
+class Aggregate(PlanNode):
+    keys: list  # (Expr, out_name)
+    aggs: list  # (E.Agg, out_name)
+    child: PlanNode = None
+    grouping_sets: Optional[list] = None  # list of key-index subsets (rollup)
+
+    def children(self):
+        return [self.child]
+
+
+@dataclass
+class Window(PlanNode):
+    fns: list  # (E.WindowFn, out_name)
+    child: PlanNode = None
+
+    def children(self):
+        return [self.child]
+
+
+@dataclass
+class Sort(PlanNode):
+    keys: list  # (Expr, ascending, nulls_first|None)
+    child: PlanNode = None
+
+    def children(self):
+        return [self.child]
+
+
+@dataclass
+class Limit(PlanNode):
+    n: int
+    child: PlanNode = None
+
+    def children(self):
+        return [self.child]
+
+
+@dataclass
+class Distinct(PlanNode):
+    child: PlanNode = None
+
+    def children(self):
+        return [self.child]
+
+
+@dataclass
+class SetOp(PlanNode):
+    op: str  # union_all | union | intersect | except
+    left: PlanNode = None
+    right: PlanNode = None
+
+    def children(self):
+        return [self.left, self.right]
+
+
+@dataclass
+class MultiJoin(PlanNode):
+    """N-way inner join over a predicate graph; the executor picks the join
+    order greedily from *actual* post-filter row counts (eager execution makes
+    real sizes available — the TPU answer to Spark's CBO/AQE, reference:
+    nds/properties/aqe-on.properties)."""
+
+    relations: list = field(default_factory=list)  # PlanNodes
+    edges: list = field(default_factory=list)  # (i, j, left_expr, right_expr)
+    residual: Optional[E.Expr] = None
+
+    def children(self):
+        return list(self.relations)
+
+
+@dataclass
+class MaterializedScan(PlanNode):
+    """Scan of an already-materialized Table (CTE result, temp view)."""
+
+    name: str
+    table: object = None  # columnar.Table
+
+
+def explain(node: PlanNode, indent=0) -> str:
+    pad = "  " * indent
+    name = type(node).__name__
+    desc = {
+        "Scan": lambda: f"Scan {node.table} as {node.alias}",
+        "MaterializedScan": lambda: f"MaterializedScan {node.name}",
+        "Project": lambda: f"Project [{', '.join(n for _, n in node.items)}]",
+        "Filter": lambda: f"Filter {node.predicate}",
+        "Join": lambda: f"Join {node.kind} on {list(zip(node.left_keys, node.right_keys))}"
+        + (f" residual {node.residual}" if node.residual else ""),
+        "Aggregate": lambda: f"Aggregate keys=[{', '.join(n for _, n in node.keys)}] "
+        f"aggs=[{', '.join(n for _, n in node.aggs)}]"
+        + (f" sets={node.grouping_sets}" if node.grouping_sets else ""),
+        "Window": lambda: f"Window [{', '.join(n for _, n in node.fns)}]",
+        "Sort": lambda: f"Sort {[(str(k), a) for k, a, _ in node.keys]}",
+        "Limit": lambda: f"Limit {node.n}",
+        "Distinct": lambda: "Distinct",
+        "SetOp": lambda: f"SetOp {node.op}",
+    }.get(name, lambda: name)()
+    out = pad + desc + "\n"
+    for c in node.children():
+        if c is not None:
+            out += explain(c, indent + 1)
+    return out

@@ -1,0 +1,298 @@
+"""Session: the user-facing entry point of the TPU SQL engine.
+
+Plays the role SparkSession plays for the reference harness (reference:
+nds/nds_power.py:184-233 builds the session, registers temp views, runs
+`spark.sql(q)` then collect()/write). A Session owns a catalog of named
+datasets (Arrow-backed files or in-memory tables), parses + binds + executes
+SQL, and returns Arrow tables.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import pyarrow as pa
+import pyarrow.dataset as pads
+
+from ..schema import get_schemas, get_maintenance_schemas
+from . import expr as E
+from . import plan as P
+from .binder import Binder
+from .columnar import Table, table_from_arrow, table_to_arrow
+from .exec import Executor
+from .sql import ast as A
+from .sql.parser import parse_sql, parse_script
+
+
+class _Entry:
+    def __init__(self, schema=None, arrow=None, path=None, fmt=None, device=None):
+        self.schema = schema  # nds_tpu Schema or None (infer)
+        self.arrow = arrow  # pa.Table (in-memory)
+        self.path = path  # file/dir path
+        self.fmt = fmt  # parquet | csv | orc
+        self.device = device  # cached device Table
+
+
+class Catalog:
+    def __init__(self, session):
+        self.session = session
+        self.entries = {}  # name -> _Entry
+
+    def schema(self, name):
+        e = self.entries.get(name)
+        if e is None:
+            return None
+        if e.schema is not None:
+            return e.schema
+        # infer a Schema facade from arrow metadata
+        at = self._arrow_schema(e)
+        from ..schema import Schema, Field
+        from .columnar import _infer_dtype
+
+        return Schema(
+            tuple(Field(f.name, _infer_dtype(f.type)) for f in at)
+        )
+
+    def _arrow_schema(self, e: _Entry):
+        if e.arrow is not None:
+            return e.arrow.schema
+        ds = pads.dataset(e.path, format=e.fmt)
+        return ds.schema
+
+    def load(self, name, columns=None) -> Table:
+        e = self.entries.get(name)
+        if e is None:
+            raise KeyError(f"unknown table {name}")
+        if e.device is not None and columns is None:
+            return e.device
+        arrow = e.arrow
+        if arrow is None:
+            ds = pads.dataset(e.path, format=e.fmt)
+            arrow = ds.to_table(columns=columns)
+        elif columns is not None:
+            arrow = arrow.select(columns)
+        t = table_from_arrow(arrow, e.schema)
+        if columns is None:
+            e.device = t
+        return t
+
+    def invalidate(self, name):
+        e = self.entries.get(name)
+        if e is not None:
+            e.device = None
+
+
+class Result:
+    """Executed query result."""
+
+    def __init__(self, session, plan_node):
+        self.session = session
+        self.plan = plan_node
+        self._table = None
+
+    def table(self) -> Table:
+        if self._table is None:
+            self._table = self.session._executor().execute(self.plan)
+        return self._table
+
+    def collect(self) -> pa.Table:
+        return table_to_arrow(self.table())
+
+    def to_pylist(self):
+        return self.collect().to_pylist()
+
+    def num_rows(self):
+        return self.table().nrows
+
+    def explain(self) -> str:
+        return P.explain(self.plan)
+
+    def write_parquet(self, path):
+        import pyarrow.parquet as pq
+
+        pq.write_table(self.collect(), path)
+
+
+class Session:
+    def __init__(self, use_decimal: bool = True):
+        self.use_decimal = use_decimal
+        self.catalog = Catalog(self)
+        self._listeners = []  # task-failure observers (harness parity)
+
+    # ---- registration ----------------------------------------------------
+    def register_arrow(self, name, arrow: pa.Table, schema=None):
+        self.catalog.entries[name.lower()] = _Entry(schema=schema, arrow=arrow)
+
+    def register_parquet(self, name, path, schema=None):
+        self.catalog.entries[name.lower()] = _Entry(
+            schema=schema, path=path, fmt="parquet"
+        )
+
+    def register_csv_dir(self, name, path, schema):
+        """Raw pipe-delimited .dat directory (generator output layout)."""
+        from ..io.csv import read_dat_dir
+
+        arrow = read_dat_dir(path, schema, self.use_decimal)
+        self.register_arrow(name, arrow, schema)
+
+    def register_nds_tables(self, data_root, fmt="parquet", maintenance=False):
+        """Register all source (or maintenance) tables under a warehouse dir."""
+        schemas = (
+            get_maintenance_schemas(self.use_decimal)
+            if maintenance
+            else get_schemas(self.use_decimal)
+        )
+        for tname, schema in schemas.items():
+            path = os.path.join(data_root, tname)
+            if os.path.exists(path):
+                self.catalog.entries[tname] = _Entry(
+                    schema=schema, path=path, fmt=fmt
+                )
+
+    def drop(self, name):
+        self.catalog.entries.pop(name.lower(), None)
+
+    # ---- listeners (reference: python_listener/PythonListener.py) --------
+    def register_listener(self, cb):
+        self._listeners.append(cb)
+
+    def notify_failure(self, reason: str):
+        for cb in self._listeners:
+            cb(reason)
+
+    # ---- SQL -------------------------------------------------------------
+    def _executor(self):
+        return Executor(self.catalog)
+
+    def sql(self, text: str) -> Result:
+        stmt = parse_sql(text)
+        return self.run_stmt(stmt)
+
+    def run_script(self, text: str):
+        out = None
+        for stmt in parse_script(text):
+            out = self.run_stmt(stmt)
+        return out
+
+    def run_stmt(self, stmt) -> Optional[Result]:
+        if isinstance(stmt, A.SelectStmt):
+            binder = Binder(self.catalog)
+            plan = binder.bind(stmt)
+            plan = prune_columns(plan, self.catalog)
+            return Result(self, plan)
+        if isinstance(stmt, A.CreateViewStmt):
+            binder = Binder(self.catalog)
+            plan = binder.bind(stmt.query)
+            plan = prune_columns(plan, self.catalog)
+            arrow = Result(self, plan).collect()
+            self.register_arrow(stmt.name, arrow)
+            return None
+        if isinstance(stmt, A.DropViewStmt):
+            self.drop(stmt.name)
+            return None
+        if isinstance(stmt, (A.InsertStmt, A.DeleteStmt, A.CreateTableStmt, A.CallStmt)):
+            from ..lakehouse.dml import run_dml
+
+            return run_dml(self, stmt)
+        raise TypeError(f"unsupported statement {type(stmt).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# Projection pruning: annotate Scans with the minimal column set
+# ---------------------------------------------------------------------------
+
+
+def prune_columns(node: P.PlanNode, catalog=None) -> P.PlanNode:
+    """Top-down required-column propagation; sets Scan.columns so the IO layer
+    only reads/transfers what the query touches (the columnar-format win the
+    reference gets from parquet + Spark column pruning)."""
+
+    def expr_refs(e):
+        return {c.name for c in E.walk(e) if isinstance(c, E.Col)}
+
+    def visit(n, req):
+        if isinstance(n, P.Scan):
+            if req is None:
+                n.columns = None
+            else:
+                bare = sorted({r.split(".", 1)[1] for r in req if r.startswith(n.alias + ".")})
+                if not bare and catalog is not None:
+                    # a pure row-count consumer (e.g. bare count(*)) still
+                    # needs one physical column to carry the row count
+                    sch = catalog.schema(n.table)
+                    if sch is not None:
+                        bare = [sch.names[0]]
+                n.columns = bare or None
+            return
+        if isinstance(n, P.Project):
+            child_req = set()
+            for e, _ in n.items:
+                child_req |= expr_refs(e)
+            visit(n.child, child_req)
+            return
+        if isinstance(n, P.Filter):
+            if req is None:
+                visit(n.child, None)
+            else:
+                visit(n.child, req | expr_refs(n.predicate))
+            return
+        if isinstance(n, P.Join):
+            extra = set()
+            for e in n.left_keys + n.right_keys:
+                extra |= expr_refs(e)
+            if n.residual is not None:
+                extra |= expr_refs(n.residual)
+            sub = None if req is None else req | extra
+            visit(n.left, sub)
+            visit(n.right, sub)
+            return
+        if isinstance(n, P.MultiJoin):
+            extra = set()
+            for _, _, le, re_ in n.edges:
+                extra |= expr_refs(le) | expr_refs(re_)
+            if n.residual is not None:
+                extra |= expr_refs(n.residual)
+            sub = None if req is None else req | extra
+            for r in n.relations:
+                visit(r, sub)
+            return
+        if isinstance(n, P.Aggregate):
+            child_req = set()
+            for e, _ in n.keys:
+                child_req |= expr_refs(e)
+            for a, _ in n.aggs:
+                if a.arg is not None:
+                    child_req |= expr_refs(a.arg)
+            visit(n.child, child_req)
+            return
+        if isinstance(n, P.Window):
+            child_req = set() if req is None else set(req)
+            for wf, _ in n.fns:
+                for c in wf.children():
+                    child_req |= expr_refs(c)
+            visit(n.child, None if req is None else child_req)
+            return
+        if isinstance(n, P.Sort):
+            child_req = None
+            if req is not None:
+                child_req = set(req)
+                for e, _, _ in n.keys:
+                    child_req |= expr_refs(e)
+            visit(n.child, child_req)
+            return
+        if isinstance(n, (P.Limit, P.Distinct)):
+            visit(n.child, req)
+            return
+        if isinstance(n, P.SetOp):
+            visit(n.left, None)
+            visit(n.right, None)
+            return
+        if isinstance(n, P.MaterializedScan):
+            return
+        for c in n.children():
+            if c is not None:
+                visit(c, None)
+
+    visit(node, None)
+    return node

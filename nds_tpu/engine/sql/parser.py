@@ -264,7 +264,7 @@ class Parser:
                 op = "union all"
             elif op in ("intersect", "except"):
                 self.accept_kw("all")  # treated as set semantics
-            rhs = self.parse_select_core_or_paren()
+            rhs = self.parse_select_core_or_paren(in_setop=True)
             stmt.set_ops.append((op, rhs))
         # trailing ORDER BY / LIMIT bind to the whole set expression
         if self.accept_kw("order"):
@@ -274,14 +274,14 @@ class Parser:
             stmt.limit = int(self.next().value)
         return stmt
 
-    def parse_select_core_or_paren(self):
+    def parse_select_core_or_paren(self, in_setop=False):
         if self.accept_op("("):
             s = self.parse_select()
             self.expect_op(")")
             return s
-        return self.parse_select_core()
+        return self.parse_select_core(in_setop=in_setop)
 
-    def parse_select_core(self) -> A.SelectStmt:
+    def parse_select_core(self, in_setop=False) -> A.SelectStmt:
         if self.accept_op("("):
             s = self.parse_select()
             self.expect_op(")")
@@ -349,16 +349,15 @@ class Parser:
                 stmt.group_by = self.expr_list()
         if self.accept_kw("having"):
             stmt.having = self.expr()
-        if self.at_kw("order") and not self._order_belongs_to_setop():
-            self.next()
-            self.expect_kw("by")
-            stmt.order_by = self.order_items()
-        if self.accept_kw("limit"):
-            stmt.limit = int(self.next().value)
+        # When this core is the RHS of a set operation, a trailing
+        # ORDER BY / LIMIT belongs to the whole set expression, not the core.
+        if not in_setop:
+            if self.accept_kw("order"):
+                self.expect_kw("by")
+                stmt.order_by = self.order_items()
+            if self.accept_kw("limit"):
+                stmt.limit = int(self.next().value)
         return stmt
-
-    def _order_belongs_to_setop(self):
-        return False  # ORDER BY after a core select binds to it (no lookahead needed)
 
     def order_items(self):
         items = []

@@ -1,0 +1,55 @@
+"""Raw data ingestion: pipe-delimited .dat files -> Arrow.
+
+Counterpart of the reference's CSV scan (reference: nds/nds_transcode.py:56-58
+`session.read.option(delimiter='|').option('header','false').csv(path, schema)`).
+Generator rows end with a trailing '|' so a phantom empty column is appended
+during parse and dropped here; empty strings are nulls.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+
+import pyarrow as pa
+import pyarrow.csv as pacsv
+
+
+def _read_options(schema):
+    names = [f.name for f in schema] + ["_trailing"]
+    return pacsv.ReadOptions(column_names=names)
+
+
+def _parse_options():
+    return pacsv.ParseOptions(delimiter="|")
+
+
+def _convert_options(schema, use_decimal):
+    types = {f.name: f.dtype.to_arrow(use_decimal) for f in schema}
+    types["_trailing"] = pa.string()
+    return pacsv.ConvertOptions(
+        column_types=types,
+        strings_can_be_null=True,
+        quoted_strings_can_be_null=True,
+    )
+
+
+def read_dat_file(path, schema, use_decimal=True) -> pa.Table:
+    t = pacsv.read_csv(
+        path,
+        read_options=_read_options(schema),
+        parse_options=_parse_options(),
+        convert_options=_convert_options(schema, use_decimal),
+    )
+    return t.drop_columns(["_trailing"])
+
+
+def read_dat_dir(path, schema, use_decimal=True) -> pa.Table:
+    """Read a per-table directory of chunk files (or a single file)."""
+    if os.path.isfile(path):
+        return read_dat_file(path, schema, use_decimal)
+    files = sorted(glob.glob(os.path.join(path, "*.dat")))
+    if not files:
+        raise FileNotFoundError(f"no .dat files under {path}")
+    parts = [read_dat_file(f, schema, use_decimal) for f in files]
+    return pa.concat_tables(parts)

@@ -1,0 +1,366 @@
+"""End-to-end SQL engine tests: parse -> bind -> execute on device tables,
+checked against hand-computed results and pandas oracles."""
+
+from decimal import Decimal
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from nds_tpu.engine.session import Session
+
+rng = np.random.default_rng(7)
+
+
+@pytest.fixture(scope="module")
+def sess():
+    s = Session()
+    n = 500
+    item = pa.table(
+        {
+            "i_item_sk": pa.array(range(1, 51), pa.int32()),
+            "i_brand_id": pa.array([i % 5 + 1 for i in range(50)], pa.int32()),
+            "i_brand": pa.array([f"brand{i % 5 + 1}" for i in range(50)]),
+            "i_category": pa.array(
+                [["Books", "Music", "Shoes"][i % 3] for i in range(50)]
+            ),
+            "i_price": pa.array(
+                [Decimal(f"{(i % 20) + 0.5:.2f}") for i in range(50)],
+                pa.decimal128(7, 2),
+            ),
+        }
+    )
+    date_dim = pa.table(
+        {
+            "d_date_sk": pa.array(range(1, 101), pa.int32()),
+            "d_year": pa.array([1998 + i // 50 for i in range(100)], pa.int32()),
+            "d_moy": pa.array([i % 12 + 1 for i in range(100)], pa.int32()),
+        }
+    )
+    sales_item = rng.integers(1, 51, n)
+    sales_date = rng.integers(1, 101, n)
+    qty = rng.integers(1, 10, n)
+    price = rng.integers(100, 10000, n)  # cents
+    cust = rng.integers(1, 21, n)
+    store_sales = pa.table(
+        {
+            "ss_item_sk": pa.array(sales_item, pa.int32()),
+            "ss_sold_date_sk": pa.array(
+                [None if i % 17 == 0 else int(v) for i, v in enumerate(sales_date)],
+                pa.int32(),
+            ),
+            "ss_customer_sk": pa.array(cust, pa.int32()),
+            "ss_quantity": pa.array(qty, pa.int32()),
+            "ss_price": pa.array(
+                [Decimal(int(p)) / 100 for p in price], pa.decimal128(7, 2)
+            ),
+        }
+    )
+    s.register_arrow("item", item)
+    s.register_arrow("date_dim", date_dim)
+    s.register_arrow("store_sales", store_sales)
+    s._pd = {
+        "item": item.to_pandas(),
+        "date_dim": date_dim.to_pandas(),
+        "store_sales": store_sales.to_pandas(),
+    }
+    return s
+
+
+def test_scan_filter_project(sess):
+    out = sess.sql(
+        "select i_item_sk, i_brand from item where i_brand_id = 2 order by i_item_sk"
+    ).collect()
+    pdf = sess._pd["item"]
+    expect = pdf[pdf.i_brand_id == 2].sort_values("i_item_sk")
+    assert out.column("i_item_sk").to_pylist() == expect.i_item_sk.tolist()
+    assert out.column("i_brand").to_pylist() == expect.i_brand.tolist()
+
+
+def test_join_group_order_limit(sess):
+    # q3-shaped query
+    out = sess.sql(
+        """
+        select d.d_year, i.i_brand_id brand_id, sum(ss_quantity) s
+        from date_dim d, store_sales, item i
+        where d.d_date_sk = ss_sold_date_sk and ss_item_sk = i.i_item_sk
+          and i.i_category = 'Books' and d.d_moy = 11
+        group by d.d_year, i.i_brand_id
+        order by d.d_year, s desc, brand_id
+        limit 10
+        """
+    ).collect()
+    pdf = sess._pd
+    m = pdf["store_sales"].merge(
+        pdf["date_dim"], left_on="ss_sold_date_sk", right_on="d_date_sk"
+    ).merge(pdf["item"], left_on="ss_item_sk", right_on="i_item_sk")
+    m = m[(m.i_category == "Books") & (m.d_moy == 11)]
+    e = (
+        m.groupby(["d_year", "i_brand_id"])["ss_quantity"]
+        .sum()
+        .reset_index()
+        .sort_values(
+            ["d_year", "ss_quantity", "i_brand_id"],
+            ascending=[True, False, True],
+        )
+        .head(10)
+    )
+    assert out.column("d_year").to_pylist() == e.d_year.tolist()
+    assert out.column("brand_id").to_pylist() == e.i_brand_id.tolist()
+    assert out.column("s").to_pylist() == e.ss_quantity.tolist()
+
+
+def test_decimal_agg(sess):
+    out = sess.sql(
+        "select sum(ss_price * ss_quantity) total from store_sales"
+    ).collect()
+    pdf = sess._pd["store_sales"]
+    expect = (pdf.ss_price * pdf.ss_quantity).sum()
+    got = out.column("total").to_pylist()[0]
+    assert got == expect
+
+
+def test_avg_and_count(sess):
+    out = sess.sql(
+        """
+        select ss_customer_sk, count(*) c, avg(ss_quantity) a,
+               count(distinct ss_item_sk) d
+        from store_sales group by ss_customer_sk order by ss_customer_sk
+        """
+    ).collect()
+    pdf = sess._pd["store_sales"]
+    g = pdf.groupby("ss_customer_sk")
+    e_c = g.size()
+    e_a = g.ss_quantity.mean()
+    e_d = g.ss_item_sk.nunique()
+    assert out.column("c").to_pylist() == e_c.tolist()
+    np.testing.assert_allclose(out.column("a").to_pylist(), e_a.tolist())
+    assert out.column("d").to_pylist() == e_d.tolist()
+
+
+def test_left_join_null_extension(sess):
+    out = sess.sql(
+        """
+        select i.i_item_sk, d.d_year
+        from item i left outer join
+             (select distinct ss_item_sk, d_year
+              from store_sales, date_dim where ss_sold_date_sk = d_date_sk
+                and d_year = 1998 and ss_item_sk < 3) s
+          on i.i_item_sk = s.ss_item_sk
+        left outer join date_dim d on s.d_year = d.d_date_sk
+        where i.i_item_sk <= 3 order by i.i_item_sk
+        """
+    ).collect()
+    assert out.num_rows == 3
+
+
+def test_subquery_scalar_uncorrelated(sess):
+    out = sess.sql(
+        """
+        select count(*) c from store_sales
+        where ss_quantity > (select avg(ss_quantity) from store_sales)
+        """
+    ).collect()
+    pdf = sess._pd["store_sales"]
+    expect = int((pdf.ss_quantity > pdf.ss_quantity.mean()).sum())
+    assert out.column("c").to_pylist() == [expect]
+
+
+def test_subquery_in(sess):
+    out = sess.sql(
+        """
+        select count(*) c from store_sales
+        where ss_item_sk in (select i_item_sk from item where i_category = 'Music')
+        """
+    ).collect()
+    pdf = sess._pd
+    music = set(
+        pdf["item"][pdf["item"].i_category == "Music"].i_item_sk.tolist()
+    )
+    expect = int(pdf["store_sales"].ss_item_sk.isin(music).sum())
+    assert out.column("c").to_pylist() == [expect]
+
+
+def test_subquery_correlated_scalar(sess):
+    # q1-style: rows above their group average
+    out = sess.sql(
+        """
+        select count(*) c from store_sales s1
+        where s1.ss_quantity > (
+            select avg(s2.ss_quantity) * 1.2 from store_sales s2
+            where s2.ss_customer_sk = s1.ss_customer_sk)
+        """
+    ).collect()
+    pdf = sess._pd["store_sales"]
+    avg = pdf.groupby("ss_customer_sk").ss_quantity.mean() * 1.2
+    expect = int(
+        (pdf.ss_quantity > pdf.ss_customer_sk.map(avg)).sum()
+    )
+    assert out.column("c").to_pylist() == [expect]
+
+
+def test_exists_correlated(sess):
+    out = sess.sql(
+        """
+        select count(*) c from item i
+        where exists (select 1 from store_sales where ss_item_sk = i.i_item_sk
+                      and ss_quantity > 8)
+        """
+    ).collect()
+    pdf = sess._pd
+    hot = set(
+        pdf["store_sales"][pdf["store_sales"].ss_quantity > 8].ss_item_sk
+    )
+    expect = int(pdf["item"].i_item_sk.isin(hot).sum())
+    assert out.column("c").to_pylist() == [expect]
+
+
+def test_not_in_subquery(sess):
+    out = sess.sql(
+        """
+        select count(*) c from item
+        where i_item_sk not in (select ss_item_sk from store_sales)
+        """
+    ).collect()
+    pdf = sess._pd
+    sold = set(pdf["store_sales"].ss_item_sk)
+    expect = int((~pdf["item"].i_item_sk.isin(sold)).sum())
+    assert out.column("c").to_pylist() == [expect]
+
+
+def test_union_all_and_intersect(sess):
+    out = sess.sql(
+        """
+        select i_brand_id from item where i_category = 'Books'
+        intersect
+        select i_brand_id from item where i_category = 'Music'
+        order by i_brand_id
+        """
+    ).collect()
+    pdf = sess._pd["item"]
+    b = set(pdf[pdf.i_category == "Books"].i_brand_id)
+    m = set(pdf[pdf.i_category == "Music"].i_brand_id)
+    assert out.column("i_brand_id").to_pylist() == sorted(b & m)
+
+    out2 = sess.sql(
+        """
+        select count(*) c from (
+          select i_item_sk from item where i_brand_id = 1
+          union all
+          select i_item_sk from item where i_category = 'Shoes') u
+        """
+    ).collect()
+    expect = int((pdf.i_brand_id == 1).sum() + (pdf.i_category == "Shoes").sum())
+    assert out2.column("c").to_pylist() == [expect]
+
+
+def test_cte(sess):
+    out = sess.sql(
+        """
+        with hot as (select ss_item_sk, sum(ss_quantity) q
+                     from store_sales group by ss_item_sk)
+        select count(*) c from hot where q > 50
+        """
+    ).collect()
+    pdf = sess._pd["store_sales"]
+    q = pdf.groupby("ss_item_sk").ss_quantity.sum()
+    assert out.column("c").to_pylist() == [int((q > 50).sum())]
+
+
+def test_rollup(sess):
+    out = sess.sql(
+        """
+        select i_category, i_brand_id, sum(i_price) p
+        from item group by rollup(i_category, i_brand_id)
+        order by i_category nulls last, i_brand_id nulls last
+        """
+    ).collect()
+    pdf = sess._pd["item"]
+    detail = pdf.groupby(["i_category", "i_brand_id"]).i_price.sum()
+    ncats = pdf.i_category.nunique()
+    # detail rows + per-category subtotals + grand total
+    assert out.num_rows == len(detail) + ncats + 1
+    total_row = out.to_pylist()[-1]
+    assert total_row["i_category"] is None and total_row["i_brand_id"] is None
+    assert float(total_row["p"]) == pytest.approx(float(pdf.i_price.sum()))
+
+
+def test_having(sess):
+    out = sess.sql(
+        """
+        select ss_item_sk from store_sales group by ss_item_sk
+        having count(*) > 12 order by ss_item_sk
+        """
+    ).collect()
+    pdf = sess._pd["store_sales"]
+    e = pdf.groupby("ss_item_sk").size()
+    assert out.column("ss_item_sk").to_pylist() == sorted(e[e > 12].index.tolist())
+
+
+def test_window_rank(sess):
+    out = sess.sql(
+        """
+        select i_category, i_item_sk,
+               rank() over (partition by i_category order by i_price desc) rk
+        from item
+        """
+    ).collect()
+    pdf = sess._pd["item"].copy()
+    pdf["rk"] = pdf.groupby("i_category").i_price.rank(
+        method="min", ascending=False
+    )
+    got = {
+        (r["i_category"], r["i_item_sk"]): r["rk"] for r in out.to_pylist()
+    }
+    for _, row in pdf.iterrows():
+        assert got[(row.i_category, row.i_item_sk)] == int(row.rk)
+
+
+def test_window_sum_partition(sess):
+    out = sess.sql(
+        """
+        select i_item_sk, sum(i_price) over (partition by i_category) t
+        from item
+        """
+    ).collect()
+    pdf = sess._pd["item"].copy()
+    t = pdf.groupby("i_category").i_price.transform("sum")
+    got = dict(zip(out.column("i_item_sk").to_pylist(), out.column("t").to_pylist()))
+    for sk, expect in zip(pdf.i_item_sk, t):
+        assert got[sk] == expect
+
+
+def test_case_in_aggregation(sess):
+    out = sess.sql(
+        """
+        select sum(case when d_year = 1998 then ss_quantity else 0 end) a,
+               sum(case when d_year = 1999 then ss_quantity else 0 end) b
+        from store_sales, date_dim where ss_sold_date_sk = d_date_sk
+        """
+    ).collect()
+    pdf = sess._pd
+    m = pdf["store_sales"].merge(
+        pdf["date_dim"], left_on="ss_sold_date_sk", right_on="d_date_sk"
+    )
+    assert out.column("a").to_pylist() == [
+        int(m[m.d_year == 1998].ss_quantity.sum())
+    ]
+    assert out.column("b").to_pylist() == [
+        int(m[m.d_year == 1999].ss_quantity.sum())
+    ]
+
+
+def test_distinct(sess):
+    out = sess.sql(
+        "select distinct i_category from item order by i_category"
+    ).collect()
+    assert out.column("i_category").to_pylist() == ["Books", "Music", "Shoes"]
+
+
+def test_global_agg_empty_filter(sess):
+    out = sess.sql(
+        "select count(*) c, sum(ss_quantity) s from store_sales where ss_quantity > 1000"
+    ).collect()
+    assert out.column("c").to_pylist() == [0]
+    assert out.column("s").to_pylist() == [None]

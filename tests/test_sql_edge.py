@@ -1,0 +1,135 @@
+"""Regression tests for review findings: OR-disjunct subqueries (mark joins),
+CTE visibility in subqueries, bare count(*), intersect nullability, right-join
+residuals, window frames."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from nds_tpu.engine.session import Session
+
+
+@pytest.fixture(scope="module")
+def sess():
+    s = Session()
+    s.register_arrow(
+        "a",
+        pa.table({"k": pa.array([1, 3], pa.int32()), "x": pa.array([10, 0], pa.int32())}),
+    )
+    s.register_arrow("b", pa.table({"k": pa.array([1], pa.int32())}))
+    s.register_arrow(
+        "nn",
+        pa.table({"x": pa.array([1, 2, None, 4], pa.int32())}),
+    )
+    s.register_arrow("mm", pa.table({"x": pa.array([2, 4, 5], pa.int32())}))
+    s.register_arrow(
+        "j1",
+        pa.table({"k": pa.array([1, 2], pa.int32()), "x": pa.array([10, 0], pa.int32())}),
+    )
+    s.register_arrow(
+        "j2",
+        pa.table({"k": pa.array([1, 2], pa.int32()), "y": pa.array([5, 5], pa.int32())}),
+    )
+    s.register_arrow(
+        "w",
+        pa.table(
+            {
+                "g": pa.array([1, 1, 1], pa.int32()),
+                "o": pa.array([1, 2, 3], pa.int32()),
+                "v": pa.array([1, 10, 100], pa.int32()),
+            }
+        ),
+    )
+    return s
+
+
+def test_exists_under_or(sess):
+    out = sess.sql(
+        "select count(*) c from a where x = 0 or exists "
+        "(select 1 from b where b.k = a.k)"
+    ).collect()
+    assert out.column("c").to_pylist() == [2]
+
+
+def test_two_exists_or(sess):
+    out = sess.sql(
+        "select count(*) c from a where exists (select 1 from b where b.k = a.k)"
+        " or exists (select 1 from mm where mm.x = a.k)"
+    ).collect()
+    # k=1 matches b; k=3 matches neither (mm has 2,4,5)
+    assert out.column("c").to_pylist() == [1]
+
+
+def test_cte_in_subquery(sess):
+    out = sess.sql(
+        """
+        with v as (select k from b)
+        select count(*) c from a where k in (select k from v)
+        """
+    ).collect()
+    assert out.column("c").to_pylist() == [1]
+
+
+def test_bare_count_star(sess):
+    out = sess.sql("select count(*) c from a").collect()
+    assert out.column("c").to_pylist() == [2]
+
+
+def test_intersect_nullability_mismatch(sess):
+    out = sess.sql(
+        "select x from nn intersect select x from mm order by x"
+    ).collect()
+    assert out.column("x").to_pylist() == [2, 4]
+    out2 = sess.sql(
+        "select x from nn except select x from mm order by x nulls last"
+    ).collect()
+    assert out2.column("x").to_pylist() == [1, None]
+
+
+def test_right_join_residual(sess):
+    out = sess.sql(
+        "select j2.k kk, j1.x from j1 right join j2 on j1.k = j2.k and j1.x < j2.y"
+        " order by kk"
+    ).collect()
+    rows = out.to_pylist()
+    assert rows == [{"kk": 1, "x": None}, {"kk": 2, "x": 0}]
+
+
+def test_window_running_default_range(sess):
+    out = sess.sql(
+        "select o, sum(v) over (partition by g order by o) s from w order by o"
+    ).collect()
+    assert out.column("s").to_pylist() == [1, 11, 111]
+
+
+def test_window_rows_bounded(sess):
+    out = sess.sql(
+        "select o, sum(v) over (partition by g order by o "
+        "rows between 1 preceding and current row) s from w order by o"
+    ).collect()
+    assert out.column("s").to_pylist() == [1, 11, 110]
+
+
+def test_window_rows_centered(sess):
+    out = sess.sql(
+        "select o, avg(v) over (partition by g order by o "
+        "rows between 1 preceding and 1 following) s from w order by o"
+    ).collect()
+    got = out.column("s").to_pylist()
+    assert got == [pytest.approx(5.5), pytest.approx(37.0), pytest.approx(55.0)]
+
+
+def test_window_range_peers(sess):
+    # ties in the order key: RANGE default includes peers
+    s2 = Session()
+    s2.register_arrow(
+        "t",
+        pa.table(
+            {
+                "o": pa.array([1, 1, 2], pa.int32()),
+                "v": pa.array([1, 10, 100], pa.int32()),
+            }
+        ),
+    )
+    out = s2.sql("select o, sum(v) over (order by o) s from t order by o").collect()
+    assert out.column("s").to_pylist() == [11, 11, 111]
