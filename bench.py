@@ -1,0 +1,88 @@
+"""Benchmark entry point for the driver: ONE JSON line on stdout.
+
+Measures the NDS Power-Run hot path on the real chip: a q3-shaped
+scan -> star-join -> filter -> group-aggregate -> sort over generated
+store_sales data, through the full SQL engine (parse/bind/execute on device).
+Metric: fact rows processed per second per chip, steady-state (post-compile).
+
+The reference publishes no numbers (BASELINE.md); vs_baseline is reported
+against the configured target in BASELINE.json terms as 1.0 until a recorded
+baseline exists.
+"""
+
+import json
+import os
+import statistics
+import subprocess
+import sys
+import time
+
+SCALE = float(os.environ.get("NDS_BENCH_SCALE", "0.1"))
+DATA_DIR = os.environ.get("NDS_BENCH_DATA", f"/tmp/nds_bench_sf{SCALE}")
+QUERY = """
+select d.d_year, i.i_brand_id brand_id, i.i_brand brand,
+       sum(ss_ext_sales_price) sum_agg
+from date_dim d, store_sales, item i
+where d.d_date_sk = ss_sold_date_sk and ss_item_sk = i.i_item_sk
+  and i.i_manager_id = 10 and d.d_moy = 11
+group by d.d_year, i.i_brand, i.i_brand_id
+order by d.d_year, sum_agg desc, brand_id
+limit 100
+"""
+
+
+def ensure_data():
+    marker = os.path.join(DATA_DIR, ".complete")
+    if os.path.exists(marker):
+        return
+    here = os.path.dirname(os.path.abspath(__file__))
+    subprocess.run(
+        [
+            sys.executable, "-m", "nds_tpu.cli.gen_data",
+            "--scale", str(SCALE), "--parallel", "2",
+            "--data_dir", DATA_DIR, "--overwrite_output",
+        ],
+        check=True,
+        cwd=here,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    open(marker, "w").close()
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    ensure_data()
+
+    from nds_tpu.engine.session import Session
+    from nds_tpu.schema import get_schemas
+
+    sess = Session()
+    schemas = get_schemas()
+    for t in ("store_sales", "item", "date_dim"):
+        sess.register_csv_dir(t, os.path.join(DATA_DIR, t), schemas[t])
+    fact_rows = sess.catalog.load("store_sales").nrows
+
+    # warmup: trigger device transfer + compile cache
+    sess.sql(QUERY).collect()
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        sess.sql(QUERY).collect()
+        times.append(time.perf_counter() - t0)
+    t = statistics.median(times)
+    rows_per_sec = fact_rows / t
+    print(
+        json.dumps(
+            {
+                "metric": "nds_q3_fact_rows_per_sec_per_chip",
+                "value": round(rows_per_sec),
+                "unit": "rows/s",
+                "vs_baseline": 1.0,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -512,22 +512,52 @@ class Binder:
                 sub.query, scope, views
             )
             sub_cols = self._subquery_out_cols
-            kind = "anti" if (sub.negated or _under_not(conj, sub)) else "semi"
+            negated = sub.negated or _under_not(conj, sub)
+            kind = "anti" if negated else "semi"
             lkeys = [operand] + [o for o, _ in joins]
             rkeys = [E.Col(sub_cols[0][0])] + [i for _, i in joins]
-            return lambda base: P.Join(kind, base, inner_plan, lkeys, rkeys)
+
+            def apply_in(base):
+                out = P.Join(kind, base, inner_plan, lkeys, rkeys)
+                if negated:
+                    # NOT IN three-valued semantics: a NULL operand, or ANY
+                    # null in the subquery result, makes the predicate
+                    # UNKNOWN -> row filtered (Spark's null-aware anti join)
+                    out = P.Filter(E.UnaryOp("isnotnull", operand), out)
+                    null_count = P.Aggregate(
+                        keys=[],
+                        aggs=[(E.Agg("count", None), "_nn")],
+                        child=P.Filter(
+                            E.UnaryOp("isnull", E.Col(sub_cols[0][0])),
+                            inner_plan,
+                        ),
+                    )
+                    out = P.Filter(
+                        E.BinOp(
+                            "=",
+                            E.ScalarSubquery(plan=null_count, out_name="_nn"),
+                            E.Lit(0),
+                        ),
+                        out,
+                    )
+                return out
+
+            return apply_in
         if sub.kind == "scalar":
-            # conj is CMP(expr, subquery) possibly correlated
+            # conj is CMP(expr, subquery) possibly correlated. Use a unique
+            # placeholder for the subquery value so an outer column sharing
+            # the subquery's output alias can't collide during binding.
             inner_plan, joins = self._bind_correlated(sub.query, scope, views)
             sub_cols = self._subquery_out_cols
-            val_col = E.Col(sub_cols[0][0])
-            cmp = _replace_node(conj, sub, val_col)
-            cmp = self._bind_expr_partial(cmp, scope, views, skip={val_col.name})
+            placeholder = E.Col(self.fresh("_sqv"))
+            cmp = _replace_node(conj, sub, placeholder)
+            cmp = self._bind_expr_partial(cmp, scope, views, skip={placeholder.name})
             if not joins:
                 # uncorrelated: broadcast scalar
                 sc = E.ScalarSubquery(plan=inner_plan, out_name=sub_cols[0][0])
-                cmp2 = _replace_node(cmp, val_col, sc)
+                cmp2 = _replace_node(cmp, placeholder, sc)
                 return lambda base: P.Filter(cmp2, base)
+            cmp = _replace_node(cmp, placeholder, E.Col(sub_cols[0][0]))
             lkeys = [o for o, _ in joins]
             rkeys = [i for _, i in joins]
 
@@ -560,7 +590,27 @@ class Binder:
                 rkeys = [E.Col(sub_cols[0][0])] + rkeys
             repl = E.Col(mark)
             if sub.kind == "in" and sub.negated:
-                repl = E.UnaryOp("not", repl)
+                # null-aware NOT IN (see apply_in above): unknown unless the
+                # operand is non-null and the subquery result has no nulls
+                null_count = P.Aggregate(
+                    keys=[],
+                    aggs=[(E.Agg("count", None), "_nn")],
+                    child=P.Filter(
+                        E.UnaryOp("isnull", E.Col(sub_cols[0][0])), inner_plan
+                    ),
+                )
+                no_nulls = E.BinOp(
+                    "=",
+                    E.ScalarSubquery(plan=null_count, out_name="_nn"),
+                    E.Lit(0),
+                )
+                repl = E.BinOp(
+                    "and",
+                    E.UnaryOp("not", repl),
+                    E.BinOp(
+                        "and", E.UnaryOp("isnotnull", sub.operand), no_nulls
+                    ),
+                )
             rewritten = _replace_node(rewritten, sub, repl)
             mark_joins.append((inner_plan, lkeys, rkeys, mark))
         pred = self._bind_expr_partial(rewritten, scope, views, skip=marks)
@@ -718,10 +768,20 @@ class _CorrelatedBinder:
 
 
 def _probe_scope(binder, q, outer, views=None):
-    """Build a name-resolution-only scope for the subquery's FROM items."""
+    """Build a name-resolution-only scope for the subquery's FROM items,
+    flattening joins and covering base tables, CTE views, and derived
+    tables alike (misses here misclassify inner columns as correlations)."""
     views = views or {}
+    flat = []
+    stack = list(q.from_items)
+    while stack:
+        it = stack.pop()
+        if isinstance(it, A.JoinClause):
+            stack += [it.left, it.right]
+        else:
+            flat.append(it)
     rels = []
-    for item in q.from_items:
+    for item in flat:
         if isinstance(item, A.TableRef):
             name = item.name.lower()
             alias = item.alias or name
@@ -742,31 +802,6 @@ def _probe_scope(binder, q, outer, views=None):
                         [(f"{alias}.{f.name}", f.name, alias) for f in schema],
                     )
                 )
-        elif isinstance(item, A.JoinClause):
-            stack = [item]
-            flat = []
-            while stack:
-                it = stack.pop()
-                if isinstance(it, A.JoinClause):
-                    stack += [it.left, it.right]
-                else:
-                    flat.append(it)
-            for t in flat:
-                if isinstance(t, A.TableRef):
-                    name = t.name.lower()
-                    alias = t.alias or name
-                    schema = binder.catalog.schema(name)
-                    if schema is not None:
-                        rels.append(
-                            Relation(
-                                None,
-                                alias,
-                                [
-                                    (f"{alias}.{f.name}", f.name, alias)
-                                    for f in schema
-                                ],
-                            )
-                        )
         elif isinstance(item, A.SubqueryRef):
             # approximate: output columns from its select list aliases
             cols = []

@@ -82,13 +82,7 @@ class Executor:
 
     def _exec_filter(self, node: P.Filter) -> Table:
         child = self.execute(node.child)
-        ev = self._evaluator(child)
-        pred = ev.eval(node.predicate)
-        mask = pred.data.astype(bool)
-        if pred.valid is not None:
-            mask = mask & pred.valid
-        mask = mask & child.row_mask()
-        return self._compact(child, mask)
+        return self._compact(child, self._predicate_mask(child, node.predicate))
 
     def _exec_limit(self, node: P.Limit) -> Table:
         child = self.execute(node.child)
@@ -294,16 +288,13 @@ class Executor:
         if residual is not None:
             # build pair table first, filter, recompact
             pair = self._pair_table(left, right, pli, pri, count, rnull=None)
-            ev = self._evaluator(pair)
-            pr = ev.eval(residual)
-            pmask = pr.data.astype(bool)
-            if pr.valid is not None:
-                pmask = pmask & pr.valid
-            pmask = pmask & pair.row_mask()
+            pmask = self._predicate_mask(pair, residual)
             if kind == "inner":
                 return self._compact(pair, pmask)
-            # outer joins: surviving pairs only count as matches
-            ok2 = jnp.zeros(ok.shape, bool).at[sel].set(pmask)
+            # outer joins: surviving pairs only count as matches. Scatter with
+            # max, not set: sel's padding duplicates index 0 and a plain set
+            # could clobber candidate 0's True with a padded False.
+            ok2 = jnp.zeros(ok.shape, bool).at[sel].max(pmask)
             ok = ok & ok2
             count = K.mask_count(ok)
             out_cap = bucket_cap(max(count, 1))
@@ -362,13 +353,18 @@ class Executor:
         cap = bucket_cap(max(count, 1))
         sel = K.compact_indices(ok, cap)
         pair = self._pair_table(left, right, li[sel], ri[sel], count, None)
-        ev = self._evaluator(pair)
-        pr = ev.eval(residual)
-        pmask = pr.data.astype(bool)
+        pmask = self._predicate_mask(pair, residual)
+        # max-scatter: sel's padding duplicates index 0 (see _join residual)
+        return ok & jnp.zeros(ok.shape, bool).at[sel].max(pmask)
+
+    def _predicate_mask(self, table: Table, predicate) -> jnp.ndarray:
+        """SQL WHERE semantics: TRUE rows only (NULL/UNKNOWN filtered),
+        restricted to live rows."""
+        pr = self._evaluator(table).eval(predicate)
+        mask = pr.data.astype(bool)
         if pr.valid is not None:
-            pmask = pmask & pr.valid
-        pmask = pmask & pair.row_mask()
-        return ok & jnp.zeros(ok.shape, bool).at[sel].set(pmask)
+            mask = mask & pr.valid
+        return mask & table.row_mask()
 
     def _join_key_pair(self, a: Column, b: Column):
         """Align join key dtypes (incl. cross-dictionary string unification)."""
@@ -389,10 +385,17 @@ class Executor:
                 _cast_column(b, target, b.data.shape[0]),
             )
         if a.dtype.kind == "float64" or b.dtype.kind == "float64":
-            return (
-                _cast_column(a, FLOAT64, a.data.shape[0]),
-                _cast_column(b, FLOAT64, b.data.shape[0]),
-            )
+            # kernels compare keys as int64, which would truncate floats;
+            # bitcast instead (after normalizing -0.0 and NaN, Spark-style)
+            def as_bits(c):
+                f = _cast_column(c, FLOAT64, c.data.shape[0])
+                x = f.data
+                x = jnp.where(x == 0.0, 0.0, x)
+                x = jnp.where(jnp.isnan(x), jnp.nan, x)
+                bits = jax.lax.bitcast_convert_type(x, jnp.int64)
+                return Column(bits, INT64, f.valid)
+
+            return as_bits(a), as_bits(b)
         return (
             _cast_column(a, INT64, a.data.shape[0]),
             _cast_column(b, INT64, b.data.shape[0]),
@@ -816,26 +819,36 @@ class Executor:
         )
         end_of_part = start_of_part + part_count[gid] - 1
 
-        def bound_lo():
+        def bound_lo_raw():
             if (lo_n, lo_u) == ("unbounded", "preceding"):
                 return start_of_part
             if (lo_n, lo_u) == ("current", None):
                 return pos
             if lo_u == "preceding":
-                return jnp.maximum(pos - int(lo_n), start_of_part)
-            return jnp.minimum(pos + int(lo_n), end_of_part)  # N following
+                return pos - int(lo_n)
+            return pos + int(lo_n)  # N following
 
-        def bound_hi():
+        def bound_hi_raw():
             if (hi_n, hi_u) == ("unbounded", "following"):
                 return end_of_part
             if (hi_n, hi_u) == ("current", None):
                 return pos
             if hi_u == "following":
-                return jnp.minimum(pos + int(hi_n), end_of_part)
-            return jnp.maximum(pos - int(hi_n), start_of_part)  # N preceding
+                return pos + int(hi_n)
+            return pos - int(hi_n)  # N preceding
 
-        lo = jnp.clip(bound_lo(), 0, child.cap - 1).astype(jnp.int32)
-        hi = jnp.clip(bound_hi(), 0, child.cap - 1).astype(jnp.int32)
+        lo_raw = bound_lo_raw()
+        hi_raw = bound_hi_raw()
+        # the true frame is [lo_raw, hi_raw] intersected with the partition;
+        # it can be EMPTY (e.g. `2 preceding and 1 preceding` at the first
+        # row) — clamping alone would fake a one-row frame
+        empty = (hi_raw < lo_raw) | (hi_raw < start_of_part) | (lo_raw > end_of_part)
+        lo = jnp.clip(
+            jnp.maximum(lo_raw, start_of_part), 0, child.cap - 1
+        ).astype(jnp.int32)
+        hi = jnp.clip(
+            jnp.minimum(hi_raw, end_of_part), 0, child.cap - 1
+        ).astype(jnp.int32)
         s_hi = csum[hi]
         c_hi = cnt[hi]
         s_lo = jnp.where(lo > 0, csum[jnp.maximum(lo - 1, 0)], jnp.zeros((), csum.dtype))
@@ -846,8 +859,8 @@ class Executor:
         at_start = lo == start_of_part.astype(jnp.int32)
         s_lo = jnp.where(at_start, jnp.zeros((), csum.dtype), s_lo)
         c_lo = jnp.where(at_start, 0, c_lo)
-        s_out = s_hi - s_lo
-        c_out = c_hi - c_lo
+        s_out = jnp.where(empty, jnp.zeros((), csum.dtype), s_hi - s_lo)
+        c_out = jnp.where(empty, 0, c_hi - c_lo)
         return self._window_result(fn, s_out[inv], c_out[inv], c, dtype)
 
     def _window_result(self, fn, red, counts, c, dtype):

@@ -26,12 +26,13 @@ from .sql.parser import parse_sql, parse_script
 
 
 class _Entry:
-    def __init__(self, schema=None, arrow=None, path=None, fmt=None, device=None):
+    def __init__(self, schema=None, arrow=None, path=None, fmt=None):
         self.schema = schema  # nds_tpu Schema or None (infer)
         self.arrow = arrow  # pa.Table (in-memory)
         self.path = path  # file/dir path
         self.fmt = fmt  # parquet | csv | orc
-        self.device = device  # cached device Table
+        self.device_cols = {}  # per-column device cache: name -> Column
+        self.nrows = None
 
 
 class Catalog:
@@ -61,26 +62,37 @@ class Catalog:
         return ds.schema
 
     def load(self, name, columns=None) -> Table:
+        """Load (a projection of) a table to device, caching per column so
+        repeated queries over different column subsets never re-read or
+        re-upload what is already in HBM."""
         e = self.entries.get(name)
         if e is None:
             raise KeyError(f"unknown table {name}")
-        if e.device is not None and columns is None:
-            return e.device
-        arrow = e.arrow
-        if arrow is None:
-            ds = pads.dataset(e.path, format=e.fmt)
-            arrow = ds.to_table(columns=columns)
-        elif columns is not None:
-            arrow = arrow.select(columns)
-        t = table_from_arrow(arrow, e.schema)
         if columns is None:
-            e.device = t
-        return t
+            sch = self.schema(name)
+            columns = sch.names
+        missing = [c for c in columns if c not in e.device_cols]
+        if missing:
+            arrow = e.arrow
+            if arrow is None:
+                ds = pads.dataset(e.path, format=e.fmt)
+                arrow = ds.to_table(columns=missing)
+            else:
+                arrow = arrow.select(missing)
+            t = table_from_arrow(arrow, e.schema)
+            e.nrows = t.nrows
+            e.device_cols.update(t.columns)
+        if e.nrows is None:
+            # all requested columns cached but nrows unset (can't happen in
+            # practice; guard for empty column list)
+            e.nrows = 0
+        return Table({c: e.device_cols[c] for c in columns}, e.nrows)
 
     def invalidate(self, name):
         e = self.entries.get(name)
         if e is not None:
-            e.device = None
+            e.device_cols = {}
+            e.nrows = None
 
 
 class Result:

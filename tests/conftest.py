@@ -1,12 +1,27 @@
 """Test configuration: force a virtual 8-device CPU mesh so multi-chip
-sharding paths compile and execute without TPU hardware."""
+sharding paths compile and execute without TPU hardware.
+
+NOTE: the environment's sitecustomize imports jax at interpreter startup and
+selects the axon TPU platform, so env vars are too late here — only
+jax.config.update() works. XLA_FLAGS still applies because the CPU client
+initializes lazily at the first jax.devices() call."""
 
 import os
-
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault("JAX_ENABLE_X64", "true")
-
 import sys
 
+os.environ["XLA_FLAGS"] = (
+    "--xla_force_host_platform_device_count=8 "
+    + os.environ.get("XLA_FLAGS", "").replace(
+        "--xla_force_host_platform_device_count=8", ""
+    )
+).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+assert jax.devices()[0].platform == "cpu", jax.devices()
+assert len(jax.devices()) == 8, jax.devices()

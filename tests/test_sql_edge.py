@@ -133,3 +133,47 @@ def test_window_range_peers(sess):
     )
     out = s2.sql("select o, sum(v) over (order by o) s from t order by o").collect()
     assert out.column("s").to_pylist() == [11, 11, 111]
+
+
+# ---- second review round regressions ---------------------------------------
+
+
+def test_not_in_with_nulls_in_subquery(sess):
+    # SQL 3VL: NOT IN over a set containing NULL is never TRUE
+    out = sess.sql(
+        "select count(*) c from mm where x not in (select x from nn)"
+    ).collect()
+    assert out.column("c").to_pylist() == [0]
+    # without nulls it behaves as plain anti join
+    out2 = sess.sql(
+        "select count(*) c from mm where x not in (select x from nn where x is not null)"
+    ).collect()
+    assert out2.column("c").to_pylist() == [1]  # only 5 not in {1,2,4}
+
+
+def test_scalar_subquery_alias_collision(sess):
+    out = sess.sql(
+        "select count(*) c from j1 where x > (select avg(x) x from j1)"
+    ).collect()
+    assert out.column("c").to_pylist() == [1]  # avg=5; only 10 > 5
+
+
+def test_float_join_keys():
+    import pyarrow as pa
+    from nds_tpu.engine.session import Session
+
+    s = Session()
+    s.register_arrow("fa", pa.table({"f": pa.array([1.5, 1.7, 2.0])}))
+    s.register_arrow("fb", pa.table({"f": pa.array([1.5, 2.0, 1.6])}))
+    out = s.sql(
+        "select count(*) c from fa, fb where fa.f = fb.f"
+    ).collect()
+    assert out.column("c").to_pylist() == [2]
+
+
+def test_empty_rows_frame(sess):
+    out = sess.sql(
+        "select o, sum(v) over (partition by g order by o "
+        "rows between 2 preceding and 1 preceding) s from w order by o"
+    ).collect()
+    assert out.column("s").to_pylist() == [None, 1, 11]
