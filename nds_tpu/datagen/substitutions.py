@@ -1,0 +1,269 @@
+"""Per-query parameter generators for the query templates.
+
+The dsqgen `define` equivalents: each generator draws this query's
+substitution values from a seeded RNG, using the same categorical
+vocabularies the data generator emits (nds_tpu/datagen/native/vocab.hpp), so
+predicates hit real data. Sales dates span 1998-01-01..2002-12-31
+(kSalesFirstSk..kSalesLastSk in rowcounts.hpp).
+"""
+
+from __future__ import annotations
+
+CATEGORIES = [
+    "Books", "Children", "Electronics", "Home", "Jewelry",
+    "Men", "Music", "Shoes", "Sports", "Women",
+]
+
+CLASSES = {
+    "Books": ["arts", "business", "computers", "cooking", "history", "mystery", "romance", "science"],
+    "Children": ["infants", "newborn", "school-uniforms", "toddlers", "accessories", "shirts", "pants", "swimwear"],
+    "Electronics": ["audio", "cameras", "dvd/vcr players", "karoke", "memory", "monitors", "portable", "televisions"],
+    "Home": ["bathroom", "bedding", "blinds/shades", "curtains/drapes", "decor", "flatware", "furniture", "kids"],
+    "Jewelry": ["birdal", "costume", "diamonds", "estate", "gold", "loose stones", "pendants", "rings"],
+    "Men": ["accessories", "pants", "shirts", "sports-apparel", "underwear", "dress shirts", "suits", "casual"],
+    "Music": ["classical", "country", "pop", "rock", "jazz", "blues", "folk", "world"],
+    "Shoes": ["athletic", "dress", "kids", "mens", "womens", "work", "sandals", "boots"],
+    "Sports": ["archery", "baseball", "basketball", "camping", "fishing", "fitness", "golf", "hockey"],
+    "Women": ["dresses", "fragrances", "intimates", "maternity", "swimwear", "accessories", "shirts", "pants"],
+}
+
+STATES = [
+    "AL", "AK", "AZ", "AR", "CA", "CO", "CT", "DE", "FL", "GA", "HI", "ID", "IL",
+    "IN", "IA", "KS", "KY", "LA", "ME", "MD", "MA", "MI", "MN", "MS", "MO", "MT",
+    "NE", "NV", "NH", "NJ", "NM", "NY", "NC", "ND", "OH", "OK", "OR", "PA", "RI",
+    "SC", "SD", "TN", "TX", "UT", "VT", "VA", "WA", "WV", "WI", "WY",
+]
+
+COUNTIES = [
+    "Williamson County", "Walker County", "Ziebach County", "Richland County",
+    "Barrow County", "Bronx County", "Maricopa County", "Jackson County",
+    "Franklin County", "Jefferson County", "Washington County", "Lincoln County",
+    "Madison County", "Montgomery County", "Clay County", "Marion County",
+]
+
+CITIES = [
+    "Fairview", "Midway", "Pleasant Hill", "Centerville", "Riverside",
+    "Five Points", "Oak Grove", "Pleasant Valley", "Mountain View", "Salem",
+    "Union", "Liberty", "Greenville", "Franklin", "Springfield",
+]
+
+EDUCATION = ["Primary", "Secondary", "College", "2 yr Degree", "4 yr Degree",
+             "Advanced Degree", "Unknown"]
+MARITAL = ["M", "S", "D", "W", "U"]
+GENDERS = ["M", "F"]
+BUY_POTENTIAL = ["0-500", "501-1000", "1001-5000", "5001-10000", ">10000", "Unknown"]
+COLORS = [
+    "almond", "antique", "aquamarine", "azure", "beige", "bisque", "black",
+    "blue", "blush", "brown", "chartreuse", "chocolate", "coral", "cream",
+    "cyan", "firebrick", "forest", "gainsboro", "goldenrod", "green", "grey",
+    "honeydew", "indian", "ivory", "khaki", "lavender", "lemon", "light",
+    "lime", "linen", "magenta", "maroon", "medium", "midnight", "mint",
+    "misty", "navy", "olive", "orange", "orchid", "pale", "papaya", "peach",
+    "pink", "plum", "powder", "puff", "purple", "red", "rose", "rosy",
+    "royal", "saddle", "salmon", "sandy", "seashell", "sienna", "sky",
+    "slate", "smoke", "snow", "spring", "steel", "tan", "thistle", "tomato",
+    "turquoise", "violet", "wheat", "white", "yellow",
+]
+
+SALES_YEARS = (1998, 2002)
+
+
+def _year(rng, lo=None, hi=None):
+    lo = lo or SALES_YEARS[0]
+    hi = hi or SALES_YEARS[1]
+    return int(rng.integers(lo, hi + 1))
+
+
+def _choice(rng, xs):
+    return xs[int(rng.integers(0, len(xs)))]
+
+
+def _distinct(rng, xs, n):
+    idx = rng.permutation(len(xs))[:n]
+    return [xs[i] for i in idx]
+
+
+def _date_in_year(rng, year, latest_month=11):
+    m = int(rng.integers(1, latest_month + 1))
+    d = int(rng.integers(1, 29))
+    return f"{year}-{m:02d}-{d:02d}"
+
+
+def q1(rng, scale):
+    return {"YEAR": _year(rng), "STATE": _choice(rng, STATES), "AGG_FIELD": "sr_return_amt"}
+
+
+def q3(rng, scale):
+    return {
+        "MANUFACT": int(rng.integers(1, 1001)),
+        "MONTH": int(rng.integers(11, 13)),
+        "AGGC": _choice(
+            rng,
+            ["ss_ext_sales_price", "ss_sales_price", "ss_ext_discount_amt", "ss_net_profit"],
+        ),
+    }
+
+
+def q6(rng, scale):
+    return {"YEAR": _year(rng), "MONTH": int(rng.integers(1, 8))}
+
+
+def q7(rng, scale):
+    return {
+        "YEAR": _year(rng),
+        "GEN": _choice(rng, GENDERS),
+        "MS": _choice(rng, MARITAL),
+        "ES": _choice(rng, EDUCATION[:6]),
+    }
+
+
+def q12(rng, scale):
+    year = _year(rng)
+    cats = _distinct(rng, CATEGORIES, 3)
+    return {
+        "YEAR": year,
+        "SDATE": _date_in_year(rng, year, 7),
+        "CAT_A": cats[0], "CAT_B": cats[1], "CAT_C": cats[2],
+    }
+
+
+def q13(rng, scale):
+    ms = _distinct(rng, MARITAL, 3)
+    es = _distinct(rng, EDUCATION[:6], 3)
+    st = [_distinct(rng, STATES, 3) for _ in range(3)]
+    out = {"MS1": ms[0], "MS2": ms[1], "MS3": ms[2],
+           "ES1": es[0], "ES2": es[1], "ES3": es[2]}
+    for g, group in enumerate(st, 1):
+        for i, s in enumerate(group, 1):
+            out[f"STATE{g}{i}"] = s
+    return out
+
+
+def q15(rng, scale):
+    return {"YEAR": _year(rng), "QOY": int(rng.integers(1, 3))}
+
+
+def q19(rng, scale):
+    return {
+        "YEAR": _year(rng),
+        "MONTH": int(rng.integers(11, 13)),
+        "MANAGER": int(rng.integers(1, 101)),
+    }
+
+
+def q20(rng, scale):
+    return q12(rng, scale)
+
+
+def q25(rng, scale):
+    return {"YEAR": _year(rng)}
+
+
+def q26(rng, scale):
+    return q7(rng, scale)
+
+
+def q42(rng, scale):
+    return {"YEAR": _year(rng), "MONTH": int(rng.integers(11, 13))}
+
+
+def q43(rng, scale):
+    return {"YEAR": _year(rng), "GMT": "-5"}
+
+
+def q52(rng, scale):
+    return q42(rng, scale)
+
+
+def q55(rng, scale):
+    return {"YEAR": _year(rng), "MONTH": int(rng.integers(11, 13)),
+            "MANAGER": int(rng.integers(1, 101))}
+
+
+def q96(rng, scale):
+    return {"HOUR": int(rng.integers(15, 21)), "DEPCNT": int(rng.integers(0, 10))}
+
+
+def q98(rng, scale):
+    return q12(rng, scale)
+
+
+def q37(rng, scale):
+    year = _year(rng)
+    return {
+        "SDATE": _date_in_year(rng, year, 6),
+        "PRICE": int(rng.integers(10, 61)),
+        "MANU_A": int(rng.integers(1, 1001)),
+        "MANU_B": int(rng.integers(1, 1001)),
+        "MANU_C": int(rng.integers(1, 1001)),
+        "MANU_D": int(rng.integers(1, 1001)),
+    }
+
+
+def q82(rng, scale):
+    return q37(rng, scale)
+
+
+def q41(rng, scale):
+    return {"MANUFACT": int(rng.integers(600, 701))}
+
+
+def q45(rng, scale):
+    return {"YEAR": _year(rng), "QOY": int(rng.integers(1, 3))}
+
+
+def q48(rng, scale):
+    ms = _distinct(rng, MARITAL, 3)
+    es = _distinct(rng, EDUCATION[:6], 3)
+    st = [_distinct(rng, STATES, 3) for _ in range(3)]
+    out = {"YEAR": _year(rng),
+           "MS1": ms[0], "MS2": ms[1], "MS3": ms[2],
+           "ES1": es[0], "ES2": es[1], "ES3": es[2]}
+    for g, group in enumerate(st, 1):
+        for i, s in enumerate(group, 1):
+            out[f"STATE{g}{i}"] = s
+    return out
+
+
+def q61(rng, scale):
+    return {"YEAR": _year(rng), "MONTH": int(rng.integers(11, 13)),
+            "GMT": "-5", "CATEGORY": _choice(rng, CATEGORIES)}
+
+
+def q65(rng, scale):
+    return {"YEAR": _year(rng)}
+
+
+def q68(rng, scale):
+    cities = _distinct(rng, CITIES, 2)
+    return {"YEAR": _year(rng), "CITY_A": cities[0], "CITY_B": cities[1],
+            "DEPCNT": int(rng.integers(0, 10)), "VEHCNT": int(rng.integers(-1, 5))}
+
+
+def q73(rng, scale):
+    return {"YEAR": _year(rng),
+            "BP1": _choice(rng, BUY_POTENTIAL), "BP2": _choice(rng, BUY_POTENTIAL),
+            "COUNTY1": _choice(rng, COUNTIES), "COUNTY2": _choice(rng, COUNTIES),
+            "COUNTY3": _choice(rng, COUNTIES), "COUNTY4": _choice(rng, COUNTIES)}
+
+
+def q79(rng, scale):
+    return {"YEAR": _year(rng), "DEPCNT": int(rng.integers(0, 10)),
+            "VEHCNT": int(rng.integers(-1, 5))}
+
+
+def q88(rng, scale):
+    return {"STORE": "Unknown", "DEPCNT1": int(rng.integers(0, 5)),
+            "DEPCNT2": int(rng.integers(0, 5)), "DEPCNT3": int(rng.integers(0, 5))}
+
+
+def q93(rng, scale):
+    return {"REASON": "reason 28"}
+
+
+PARAM_GENERATORS = {
+    1: q1, 3: q3, 6: q6, 7: q7, 12: q12, 13: q13, 15: q15, 19: q19, 20: q20,
+    25: q25, 26: q26, 37: q37, 41: q41, 42: q42, 43: q43, 45: q45, 48: q48,
+    52: q52, 55: q55, 61: q61, 65: q65, 68: q68, 73: q73, 79: q79, 82: q82,
+    88: q88, 93: q93, 96: q96, 98: q98,
+}

@@ -146,11 +146,15 @@ class Binder:
         residual = []
         post_join_subqueries = []  # (kind, ...) applied after MultiJoin
         if stmt.where is not None:
-            for conj in _conjuncts(stmt.where):
-                self._classify_conjunct(
-                    conj, scope, relations, views,
-                    filters_per_rel, edges, residual, post_join_subqueries,
-                )
+            for raw in _conjuncts(stmt.where):
+                # factor conjuncts common to every OR branch so join keys
+                # buried in disjunctions (TPC-DS q13/q48 shape) become edges
+                # instead of forcing a cross join
+                for conj in _factor_or(raw):
+                    self._classify_conjunct(
+                        conj, scope, relations, views,
+                        filters_per_rel, edges, residual, post_join_subqueries,
+                    )
 
         # 3. assemble join tree
         rel_plans = []
@@ -513,36 +517,20 @@ class Binder:
             )
             sub_cols = self._subquery_out_cols
             negated = sub.negated or _under_not(conj, sub)
-            kind = "anti" if negated else "semi"
-            lkeys = [operand] + [o for o, _ in joins]
-            rkeys = [E.Col(sub_cols[0][0])] + [i for _, i in joins]
+            if not negated:
+                lkeys = [operand] + [o for o, _ in joins]
+                rkeys = [E.Col(sub_cols[0][0])] + [i for _, i in joins]
+                return lambda base: P.Join("semi", base, inner_plan, lkeys, rkeys)
+            mark_specs, pred = self._not_in_lowering(
+                operand, inner_plan, joins, sub_cols
+            )
 
-            def apply_in(base):
-                out = P.Join(kind, base, inner_plan, lkeys, rkeys)
-                if negated:
-                    # NOT IN three-valued semantics: a NULL operand, or ANY
-                    # null in the subquery result, makes the predicate
-                    # UNKNOWN -> row filtered (Spark's null-aware anti join)
-                    out = P.Filter(E.UnaryOp("isnotnull", operand), out)
-                    null_count = P.Aggregate(
-                        keys=[],
-                        aggs=[(E.Agg("count", None), "_nn")],
-                        child=P.Filter(
-                            E.UnaryOp("isnull", E.Col(sub_cols[0][0])),
-                            inner_plan,
-                        ),
-                    )
-                    out = P.Filter(
-                        E.BinOp(
-                            "=",
-                            E.ScalarSubquery(plan=null_count, out_name="_nn"),
-                            E.Lit(0),
-                        ),
-                        out,
-                    )
-                return out
+            def apply_not_in(base):
+                for plan, lk, rk, name in mark_specs:
+                    base = P.Join("mark", base, plan, lk, rk, mark_name=name)
+                return P.Filter(pred, base)
 
-            return apply_in
+            return apply_not_in
         if sub.kind == "scalar":
             # conj is CMP(expr, subquery) possibly correlated. Use a unique
             # placeholder for the subquery value so an outer column sharing
@@ -568,6 +556,57 @@ class Binder:
             return apply
         raise BindError(f"unsupported subquery kind {sub.kind}")
 
+    def _not_in_lowering(self, operand, inner_plan, joins, sub_cols):
+        """3VL-correct NOT IN as mark joins + a boolean predicate.
+
+        `x NOT IN (subquery)` is TRUE iff no inner row (of this row's
+        correlation group) equals x, no inner row of the group has a NULL
+        value, and either x is non-null or the group is empty. Returns
+        (mark_specs, predicate): mark_specs are (plan, lkeys, rkeys, name)
+        mark joins to apply to the base, predicate is the replacement expr.
+        Group-scoped marks fix the classic global-null-count bug; scalar
+        counts are only used when uncorrelated (group == whole subquery)."""
+        val = E.Col(sub_cols[0][0])
+        lcorr = [o for o, _ in joins]
+        rcorr = [i for _, i in joins]
+        m_match = self.fresh("_m")
+        specs = [(inner_plan, [operand] + lcorr, [val] + rcorr, m_match)]
+        null_rows = P.Filter(E.UnaryOp("isnull", val), inner_plan)
+        if joins:
+            m_null = self.fresh("_m")
+            m_any = self.fresh("_m")
+            specs.append((null_rows, lcorr, rcorr, m_null))
+            specs.append((inner_plan, lcorr, rcorr, m_any))
+            has_null = E.Col(m_null)
+            has_any = E.Col(m_any)
+        else:
+            null_cnt = P.Aggregate(
+                keys=[], aggs=[(E.Agg("count", None), "_nn")], child=null_rows
+            )
+            any_cnt = P.Aggregate(
+                keys=[], aggs=[(E.Agg("count", None), "_na")], child=inner_plan
+            )
+            has_null = E.BinOp(
+                ">", E.ScalarSubquery(plan=null_cnt, out_name="_nn"), E.Lit(0)
+            )
+            has_any = E.BinOp(
+                ">", E.ScalarSubquery(plan=any_cnt, out_name="_na"), E.Lit(0)
+            )
+        pred = E.BinOp(
+            "and",
+            E.BinOp(
+                "and",
+                E.UnaryOp("not", E.Col(m_match)),
+                E.UnaryOp("not", has_null),
+            ),
+            E.BinOp(
+                "or",
+                E.UnaryOp("isnotnull", operand),
+                E.UnaryOp("not", has_any),
+            ),
+        )
+        return specs, pred
+
     def _plan_marked_conjunct(self, conj, subs, scope, views):
         """Mark-join lowering for subqueries in arbitrary boolean context."""
         mark_joins = []  # (inner_plan, lkeys, rkeys, mark_name)
@@ -580,6 +619,20 @@ class Binder:
                 )
             inner_plan, joins = self._bind_correlated(sub.query, scope, views)
             sub_cols = self._subquery_out_cols
+            if sub.kind == "in" and sub.negated:
+                operand = self._bind_expr(sub.operand, scope, views)
+                specs, repl = self._not_in_lowering(
+                    operand, inner_plan, joins, sub_cols
+                )
+                for plan, lk, rk, name in specs:
+                    marks.add(name)
+                    mark_joins.append((plan, lk, rk, name))
+                # repl is fully bound already; protect it from re-binding
+                placeholder = E.Col(self.fresh("_nip"))
+                self._marked_replacements[placeholder.name] = repl
+                marks.add(placeholder.name)
+                rewritten = _replace_node(rewritten, sub, placeholder)
+                continue
             mark = self.fresh("_m")
             marks.add(mark)
             lkeys = [o for o, _ in joins]
@@ -589,31 +642,12 @@ class Binder:
                 lkeys = [operand] + lkeys
                 rkeys = [E.Col(sub_cols[0][0])] + rkeys
             repl = E.Col(mark)
-            if sub.kind == "in" and sub.negated:
-                # null-aware NOT IN (see apply_in above): unknown unless the
-                # operand is non-null and the subquery result has no nulls
-                null_count = P.Aggregate(
-                    keys=[],
-                    aggs=[(E.Agg("count", None), "_nn")],
-                    child=P.Filter(
-                        E.UnaryOp("isnull", E.Col(sub_cols[0][0])), inner_plan
-                    ),
-                )
-                no_nulls = E.BinOp(
-                    "=",
-                    E.ScalarSubquery(plan=null_count, out_name="_nn"),
-                    E.Lit(0),
-                )
-                repl = E.BinOp(
-                    "and",
-                    E.UnaryOp("not", repl),
-                    E.BinOp(
-                        "and", E.UnaryOp("isnotnull", sub.operand), no_nulls
-                    ),
-                )
             rewritten = _replace_node(rewritten, sub, repl)
             mark_joins.append((inner_plan, lkeys, rkeys, mark))
         pred = self._bind_expr_partial(rewritten, scope, views, skip=marks)
+        for name, repl in self._marked_replacements.items():
+            pred = _replace_node(pred, E.Col(name), repl)
+        self._marked_replacements = {}
 
         def apply(base):
             for inner_plan, lkeys, rkeys, mark in mark_joins:
@@ -823,6 +857,38 @@ def _conjuncts(e):
     if isinstance(e, E.BinOp) and e.op == "and":
         return _conjuncts(e.left) + _conjuncts(e.right)
     return [e]
+
+
+def _disjuncts(e):
+    if isinstance(e, E.BinOp) and e.op == "or":
+        return _disjuncts(e.left) + _disjuncts(e.right)
+    return [e]
+
+
+def _factor_or(e):
+    """(A and P1) or (A and P2) -> [A, (P1 or P2)]; identity otherwise."""
+    if not (isinstance(e, E.BinOp) and e.op == "or"):
+        return [e]
+    branch_conjs = [_conjuncts(d) for d in _disjuncts(e)]
+    common = [
+        c
+        for c in branch_conjs[0]
+        if all(any(c == x for x in s) for s in branch_conjs[1:])
+    ]
+    if not common:
+        return [e]
+    remaining = []
+    for s in branch_conjs:
+        rest = [x for x in s if not any(x == c for c in common)]
+        if not rest:
+            return list(common)  # one branch is fully covered: OR is vacuous
+        remaining.append(_conjoin(rest))
+    out = list(common)
+    disj = remaining[0]
+    for r in remaining[1:]:
+        disj = E.BinOp("or", disj, r)
+    out.append(disj)
+    return out
 
 
 def _conjoin(preds):

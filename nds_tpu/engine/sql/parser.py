@@ -785,6 +785,18 @@ def _as_lit(e):
         return e
     if isinstance(e, E.Cast) and isinstance(e.operand, E.Lit):
         return e.operand
+    # constant folding: IN lists may contain literal arithmetic like [YEAR]+1
+    if (
+        isinstance(e, E.BinOp)
+        and e.op in ("+", "-", "*")
+        and isinstance(e.left, E.Lit)
+        and isinstance(e.right, E.Lit)
+        and isinstance(e.left.value, (int, float))
+        and isinstance(e.right.value, (int, float))
+    ):
+        v = {"+": lambda a, b: a + b, "-": lambda a, b: a - b,
+             "*": lambda a, b: a * b}[e.op](e.left.value, e.right.value)
+        return E.Lit(v)
     raise SyntaxError(f"IN list must be literals, got {e}")
 
 
