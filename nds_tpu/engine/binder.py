@@ -612,6 +612,10 @@ class Binder:
         mark_joins = []  # (inner_plan, lkeys, rkeys, mark_name)
         rewritten = conj
         marks = set()
+        # local, not instance state: binding an inner subquery below can
+        # re-enter this method, which must not drain the outer call's
+        # pending placeholder substitutions
+        marked_replacements = {}
         for sub in subs:
             if sub.kind == "scalar":
                 raise BindError(
@@ -629,7 +633,7 @@ class Binder:
                     mark_joins.append((plan, lk, rk, name))
                 # repl is fully bound already; protect it from re-binding
                 placeholder = E.Col(self.fresh("_nip"))
-                self._marked_replacements[placeholder.name] = repl
+                marked_replacements[placeholder.name] = repl
                 marks.add(placeholder.name)
                 rewritten = _replace_node(rewritten, sub, placeholder)
                 continue
@@ -645,9 +649,8 @@ class Binder:
             rewritten = _replace_node(rewritten, sub, repl)
             mark_joins.append((inner_plan, lkeys, rkeys, mark))
         pred = self._bind_expr_partial(rewritten, scope, views, skip=marks)
-        for name, repl in self._marked_replacements.items():
+        for name, repl in marked_replacements.items():
             pred = _replace_node(pred, E.Col(name), repl)
-        self._marked_replacements = {}
 
         def apply(base):
             for inner_plan, lkeys, rkeys, mark in mark_joins:

@@ -628,8 +628,11 @@ class Evaluator:
             a = self.eval(e.args[0])
             b = self.eval(e.args[1])
             eq = self._compare("=", a, b, None)
+            # NULLIF(a, NULL) = a: the equality only nulls when b is valid,
+            # else a NULL b whose fill value matches a.data would null a out.
+            nulled = eq.data if b.valid is None else eq.data & b.valid
             av = a.valid if a.valid is not None else jnp.ones(self.table.cap, bool)
-            return Column(a.data, a.dtype, av & ~eq.data, a.dictionary)
+            return Column(a.data, a.dtype, av & ~nulled, a.dictionary)
         if name == "concat":
             out = self.eval(e.args[0])
             for arg in e.args[1:]:

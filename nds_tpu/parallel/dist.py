@@ -100,7 +100,13 @@ def partition_exchange(mesh: Mesh, cap_per_dev: int):
     """Returns a jitted fn that redistributes (key, value) rows so that every
     key lands on device hash(key) % n_devices. Rows are bucketed locally,
     padded to a fixed per-destination capacity, then exchanged with
-    all_to_all over ICI."""
+    all_to_all over ICI.
+
+    Returns (recv_keys, recv_vals, dropped): `dropped` is the global count of
+    live rows that exceeded cap_per_dev in some destination bucket (replicated
+    scalar). Callers MUST check dropped == 0 and retry with a larger capacity
+    on overflow — under key skew a fixed cap silently truncating would corrupt
+    join/aggregate results."""
     n_dev = mesh.devices.size
 
     def local(keys, vals, live):
@@ -118,19 +124,21 @@ def partition_exchange(mesh: Mesh, cap_per_dev: int):
         base = jnp.searchsorted(msorted, jnp.arange(n_dev), side="left")
         row = jnp.where(msorted < n_dev, msorted, n_dev)
         pos_in_bucket = jnp.arange(keys.shape[0]) - base[jnp.clip(row, 0, n_dev - 1)]
-        # overflow and dead rows scatter out of bounds -> dropped
+        # live rows past the bucket capacity would be silently lost in the
+        # scatter below — count them so callers can detect and resize
+        overflow = ((msorted < n_dev) & (pos_in_bucket >= cap_per_dev)).sum()
         row = jnp.where(pos_in_bucket < cap_per_dev, row, n_dev)
         out_k = out_k.at[row, pos_in_bucket].set(ksorted, mode="drop")
         out_v = out_v.at[row, pos_in_bucket].set(vsorted, mode="drop")
         # exchange: axis 0 indexes destination device
         rk = jax.lax.all_to_all(out_k, "data", 0, 0, tiled=True)
         rv = jax.lax.all_to_all(out_v, "data", 0, 0, tiled=True)
-        return rk.reshape(-1), rv.reshape(-1)
+        return rk.reshape(-1), rv.reshape(-1), jax.lax.psum(overflow, "data")
 
     fn = shard_map(
         local,
         mesh=mesh,
         in_specs=(P("data"), P("data"), P("data")),
-        out_specs=(P("data"), P("data")),
+        out_specs=(P("data"), P("data"), P()),
     )
     return jax.jit(fn)

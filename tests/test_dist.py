@@ -1,0 +1,97 @@
+"""Distributed primitive tests on the virtual 8-device CPU mesh.
+
+Covers the two mesh patterns the engine uses (reference analogue: Spark
+executor data parallelism + shuffle, nds/base.template:28-31):
+  * sharded star-query step (partial agg + psum) vs single-device oracle
+  * hash-partition exchange routing + overflow detection
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from nds_tpu.parallel.dist import (
+    fused_query_step,
+    make_mesh,
+    partition_exchange,
+    sharded_query_step,
+)
+
+N_DEV = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= N_DEV
+    return make_mesh(N_DEV)
+
+
+def test_sharded_star_agg_matches_oracle(mesh):
+    rng = np.random.default_rng(7)
+    n, n_dates, n_items, n_groups = 128 * N_DEV, 64, 32, 8
+    shard = NamedSharding(mesh, P("data"))
+    repl = NamedSharding(mesh, P())
+    fd = jax.device_put(jnp.asarray(rng.integers(0, n_dates, n), jnp.int32), shard)
+    fi = jax.device_put(jnp.asarray(rng.integers(0, n_items, n), jnp.int32), shard)
+    fm = jax.device_put(jnp.asarray(rng.integers(0, 1000, n), jnp.int64), shard)
+    fv = jax.device_put(jnp.asarray(rng.random(n) < 0.9), shard)
+    ddf = jax.device_put(jnp.asarray(rng.random(n_dates) < 0.5), repl)
+    dig = jax.device_put(jnp.asarray(rng.integers(-1, n_groups, n_items), jnp.int32), repl)
+
+    step = sharded_query_step(mesh, n_groups)
+    sums, counts = jax.block_until_ready(step(fd, fi, fm, fv, ddf, dig))
+    ref_s, ref_c = fused_query_step(
+        np.asarray(fd), np.asarray(fi), np.asarray(fm), np.asarray(fv),
+        np.asarray(ddf), np.asarray(dig), n_groups=n_groups,
+    )
+    np.testing.assert_array_equal(np.asarray(sums), np.asarray(ref_s))
+    np.testing.assert_array_equal(np.asarray(counts), np.asarray(ref_c))
+
+
+def test_partition_exchange_routes_keys(mesh):
+    rng = np.random.default_rng(3)
+    n, cap = 64 * N_DEV, 64
+    shard = NamedSharding(mesh, P("data"))
+    keys = jax.device_put(jnp.asarray(rng.integers(0, 1000, n), jnp.int64), shard)
+    vals = jax.device_put(jnp.asarray(rng.integers(0, 100, n), jnp.int64), shard)
+    live = jax.device_put(jnp.asarray(rng.random(n) < 0.8), shard)
+
+    ex = partition_exchange(mesh, cap)
+    rk, rv, dropped = jax.block_until_ready(ex(keys, vals, live))
+    assert int(dropped) == 0
+    rk_np = np.asarray(rk).reshape(N_DEV, -1)
+    for d in range(N_DEV):
+        got = rk_np[d][rk_np[d] >= 0]
+        assert (got % N_DEV == d).all()
+    # conservation: every live key arrives exactly once
+    sent = np.sort(np.asarray(keys)[np.asarray(live)])
+    recvd = np.sort(np.asarray(rk)[np.asarray(rk) >= 0])
+    np.testing.assert_array_equal(sent, recvd)
+    # values ride with their keys
+    rv_np = np.asarray(rv)
+    kv = {}
+    k_host, v_host, l_host = np.asarray(keys), np.asarray(vals), np.asarray(live)
+    for k, v, l in zip(k_host, v_host, l_host):
+        if l:
+            kv.setdefault(k, []).append(v)
+    got_kv = {}
+    for k, v in zip(np.asarray(rk), rv_np):
+        if k >= 0:
+            got_kv.setdefault(k, []).append(v)
+    assert {k: sorted(v) for k, v in kv.items()} == {
+        k: sorted(v) for k, v in got_kv.items()
+    }
+
+
+def test_partition_exchange_detects_overflow(mesh):
+    # all keys hash to device 0 -> bucket 0 needs n rows but cap is tiny
+    n, cap = 16 * N_DEV, 2
+    shard = NamedSharding(mesh, P("data"))
+    keys = jax.device_put(jnp.zeros(n, jnp.int64) + 8, shard)  # 8 % 8 == 0
+    vals = jax.device_put(jnp.arange(n, dtype=jnp.int64), shard)
+    live = jax.device_put(jnp.ones(n, bool), shard)
+    ex = partition_exchange(mesh, cap)
+    _, _, dropped = jax.block_until_ready(ex(keys, vals, live))
+    assert int(dropped) == n - cap * N_DEV
