@@ -17,3 +17,13 @@ Layout:
 """
 
 __version__ = "0.1.0"
+
+import os as _os
+
+if _os.environ.get("NDS_PLATFORM"):
+    # Select the jax backend before anything initializes it. The env image
+    # pre-registers the TPU plugin at interpreter start, so JAX_PLATFORMS in
+    # the environment is consumed too early — only jax.config works here.
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", _os.environ["NDS_PLATFORM"])

@@ -80,11 +80,22 @@ def get_dir_size(start_path: str) -> int:
 
 
 def check_json_summary_folder(folder: str):
-    """Refuse to clobber a non-empty summary folder (user must clean it)."""
-    if folder and os.path.exists(folder) and os.listdir(folder):
+    """Create the summary folder if needed; refuse to clobber a non-empty one
+    (user must clean it)."""
+    if not folder:
+        return folder
+    try:
+        if os.path.exists(folder):
+            if os.listdir(folder):
+                raise argparse.ArgumentTypeError(
+                    f"json summary folder {folder!r} exists and is not empty"
+                )
+        else:
+            os.makedirs(folder)
+    except OSError as exc:  # existing file, permission, ...
         raise argparse.ArgumentTypeError(
-            f"json summary folder {folder!r} exists and is not empty"
-        )
+            f"json summary folder {folder!r} unusable: {exc}"
+        ) from exc
     return folder
 
 

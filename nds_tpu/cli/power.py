@@ -1,0 +1,86 @@
+"""Power Run CLI (reference: nds/nds_power.py __main__ :309-384).
+
+    python -m nds_tpu.cli.power <input_prefix> <query_stream_file> <time_log>
+        [--input_format parquet|csv] [--output_prefix DIR]
+        [--output_format parquet|csv] [--property_file F] [--floats]
+        [--json_summary_folder DIR] [--sub_queries q1,q2,...]
+        [--extra_time_log F]
+"""
+
+import argparse
+
+from ..check import check_version
+from ..power import gen_sql_from_stream, run_query_stream
+
+
+def main(argv=None):
+    check_version()
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "input_prefix",
+        help="text to prepend to every input file path (warehouse root)",
+    )
+    parser.add_argument(
+        "query_stream_file",
+        help="query stream file that contains NDS queries in specific order",
+    )
+    parser.add_argument(
+        "time_log",
+        help="path to execution time log (CSV), only local path supported",
+        default="",
+    )
+    parser.add_argument(
+        "--input_format",
+        choices=["parquet", "csv"],
+        default="parquet",
+        help="type of the input data source",
+    )
+    parser.add_argument(
+        "--output_prefix",
+        help="text to prepend to every output file; if absent, results are "
+        "collected to host memory instead of written",
+    )
+    parser.add_argument(
+        "--output_format", default="parquet", help="type of query output"
+    )
+    parser.add_argument(
+        "--property_file", help="property file for engine configuration"
+    )
+    parser.add_argument(
+        "--floats",
+        action="store_true",
+        help="use double instead of decimal for decimal-typed columns",
+    )
+    parser.add_argument(
+        "--json_summary_folder",
+        help="empty folder (created if missing) for per-query JSON summaries",
+    )
+    parser.add_argument(
+        "--extra_time_log",
+        help="extra path to save a copy of the time log",
+    )
+    parser.add_argument(
+        "--sub_queries",
+        type=lambda s: [x.strip() for x in s.split(",")],
+        help="comma separated list of queries to run, e.g. 'query1,query2'. "
+        "Use _part1/_part2 suffixes for queries 14, 23, 24, 39.",
+    )
+    args = parser.parse_args(argv)
+    query_dict = gen_sql_from_stream(args.query_stream_file)
+    run_query_stream(
+        input_prefix=args.input_prefix,
+        property_file=args.property_file,
+        query_dict=query_dict,
+        time_log_output_path=args.time_log,
+        extra_time_log_output_path=args.extra_time_log,
+        sub_queries=args.sub_queries,
+        input_format=args.input_format,
+        use_decimal=not args.floats,
+        output_path=args.output_prefix,
+        output_format=args.output_format,
+        json_summary_folder=args.json_summary_folder,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -95,6 +95,17 @@ def generate_streams(
     return qnums
 
 
+def split_special_query(q: str):
+    """Split a two-statement stream entry (templates 14/23/24/39) into
+    _part1/_part2 pieces, renaming the .tpl tag in each header (reference:
+    nds/nds_gen_query_stream.py:91-103)."""
+    pieces = q.split(";")
+    part_1 = pieces[0].replace(".tpl", "_part1.tpl") + ";"
+    head = pieces[0].split("\n")[0]
+    part_2 = head.replace(".tpl", "_part2.tpl") + "\n" + pieces[1] + ";"
+    return part_1, part_2
+
+
 def generate_single(output_dir, template_name, scale, rngseed, template_dir=None):
     """Generate one query from one template (reference: --template flag,
     nds/nds_gen_query_stream.py:115-119)."""

@@ -37,9 +37,15 @@ class ExecError(Exception):
 
 
 class Executor:
-    def __init__(self, catalog):
-        """catalog: object with .load(table_name) -> Table"""
+    def __init__(self, catalog, on_task_failure=None):
+        """catalog: object with .load(table_name) -> Table.
+
+        on_task_failure(reason) is called for recoverable incidents the
+        executor survives (capacity-overflow retries, fallbacks) so the
+        harness can report CompletedWithTaskFailures (reference analogue:
+        Spark task retries surfaced via jvm_listener)."""
         self.catalog = catalog
+        self.on_task_failure = on_task_failure or (lambda reason: None)
         self._cte_cache = {}  # id(plan) -> Table
         self._scalar_cache = {}  # id(plan) -> python value
 

@@ -55,11 +55,49 @@ class Catalog:
             tuple(Field(f.name, _infer_dtype(f.type)) for f in at)
         )
 
+    def _dataset(self, e: _Entry):
+        # hive partitioning discovery: the transcode phase writes fact tables
+        # as <date_sk>=<value>/ directories; declare the partition field type
+        # from the table schema so keys round-trip with the right dtype
+        part = "hive"
+        fmt = e.fmt
+        if e.schema is not None:
+            from ..schema import TABLE_PARTITIONING
+
+            use_decimal = self.session.use_decimal
+            names = {f.name for f in e.schema}
+            pcols = [c for c in TABLE_PARTITIONING.values() if c in names]
+            if pcols:
+                part = pads.partitioning(
+                    pa.schema(
+                        [
+                            (c, e.schema.field(c).dtype.to_arrow(use_decimal))
+                            for c in pcols
+                        ]
+                    ),
+                    flavor="hive",
+                )
+            if e.fmt == "csv":
+                # transcoded csv warehouse (comma-delimited, with header):
+                # parse columns to the declared schema types
+                import pyarrow.csv as pacsv
+
+                fmt = pads.CsvFileFormat(
+                    convert_options=pacsv.ConvertOptions(
+                        column_types={
+                            f.name: f.dtype.to_arrow(use_decimal)
+                            for f in e.schema
+                            if f.name not in pcols
+                        },
+                        strings_can_be_null=True,
+                    )
+                )
+        return pads.dataset(e.path, format=fmt, partitioning=part)
+
     def _arrow_schema(self, e: _Entry):
         if e.arrow is not None:
             return e.arrow.schema
-        ds = pads.dataset(e.path, format=e.fmt)
-        return ds.schema
+        return self._dataset(e).schema
 
     def load(self, name, columns=None) -> Table:
         """Load (a projection of) a table to device, caching per column so
@@ -75,8 +113,7 @@ class Catalog:
         if missing:
             arrow = e.arrow
             if arrow is None:
-                ds = pads.dataset(e.path, format=e.fmt)
-                arrow = ds.to_table(columns=missing)
+                arrow = self._dataset(e).to_table(columns=missing)
             else:
                 arrow = arrow.select(missing)
             t = table_from_arrow(arrow, e.schema)
@@ -125,10 +162,27 @@ class Result:
 
         pq.write_table(self.collect(), path)
 
+    def write(self, path, fmt="parquet"):
+        """Write the result as a single-file dataset dir `path/part-0.<fmt>`
+        (the layout the validator reads back; reference analogue:
+        df.write.format(fmt).save(path), nds/nds_power.py:132-135)."""
+        import pyarrow.csv as pacsv
+        import pyarrow.parquet as pq
+
+        os.makedirs(path, exist_ok=True)
+        arrow = self.collect()
+        if fmt == "parquet":
+            pq.write_table(arrow, os.path.join(path, "part-0.parquet"))
+        elif fmt == "csv":
+            pacsv.write_csv(arrow, os.path.join(path, "part-0.csv"))
+        else:
+            raise ValueError(f"unsupported output format {fmt}")
+
 
 class Session:
-    def __init__(self, use_decimal: bool = True):
+    def __init__(self, use_decimal: bool = True, conf: Optional[dict] = None):
         self.use_decimal = use_decimal
+        self.conf = dict(conf or {})  # engine options (property-file tier)
         self.catalog = Catalog(self)
         self._listeners = []  # task-failure observers (harness parity)
 
@@ -147,6 +201,13 @@ class Session:
 
         arrow = read_dat_dir(path, schema, self.use_decimal)
         self.register_arrow(name, arrow, schema)
+
+    def register_csv_warehouse(self, name, path, schema):
+        """Transcoded csv warehouse dir (comma-delimited part files, possibly
+        hive-partitioned) — lazy, like parquet registration."""
+        self.catalog.entries[name.lower()] = _Entry(
+            schema=schema, path=path, fmt="csv"
+        )
 
     def register_nds_tables(self, data_root, fmt="parquet", maintenance=False):
         """Register all source (or maintenance) tables under a warehouse dir."""
@@ -169,13 +230,21 @@ class Session:
     def register_listener(self, cb):
         self._listeners.append(cb)
 
+    def unregister_listener(self, cb):
+        try:
+            self._listeners.remove(cb)
+        except ValueError:
+            pass
+
     def notify_failure(self, reason: str):
+        """Fan a recoverable task-failure event out to listeners (reference:
+        jvm_listener Manager.notifyAll -> PythonListener.notify)."""
         for cb in self._listeners:
             cb(reason)
 
     # ---- SQL -------------------------------------------------------------
     def _executor(self):
-        return Executor(self.catalog)
+        return Executor(self.catalog, on_task_failure=self.notify_failure)
 
     def sql(self, text: str) -> Result:
         stmt = parse_sql(text)

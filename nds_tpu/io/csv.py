@@ -53,3 +53,30 @@ def read_dat_dir(path, schema, use_decimal=True) -> pa.Table:
         raise FileNotFoundError(f"no .dat files under {path}")
     parts = [read_dat_file(f, schema, use_decimal) for f in files]
     return pa.concat_tables(parts)
+
+
+def iter_dat_batches(path, schema, use_decimal=True, block_size=64 << 20):
+    """Stream a .dat file or chunk directory as Arrow record batches.
+
+    Bounded-memory ingestion for the transcode/load phase: tables are read in
+    `block_size`-byte morsels instead of one whole-table materialization, so
+    SF100+ fact tables stream through a fixed host-memory footprint
+    (reference analogue: Spark's partitioned CSV scan, nds/nds_transcode.py:56-58).
+    """
+    if os.path.isfile(path):
+        files = [path]
+    else:
+        files = sorted(glob.glob(os.path.join(path, "*.dat")))
+        if not files:
+            raise FileNotFoundError(f"no .dat files under {path}")
+    ropts = _read_options(schema)
+    ropts.block_size = block_size
+    for f in files:
+        with pacsv.open_csv(
+            f,
+            read_options=ropts,
+            parse_options=_parse_options(),
+            convert_options=_convert_options(schema, use_decimal),
+        ) as reader:
+            for batch in reader:
+                yield batch.drop_columns(["_trailing"])

@@ -1,0 +1,108 @@
+"""Per-query benchmark report: timing + environment + status JSON summary.
+
+TPU-native counterpart of the reference's PysparkBenchReport + listener chain
+(reference: nds/PysparkBenchReport.py:58-119, nds/python_listener/
+PythonListener.py:5-45, nds/jvm_listener/.../TaskFailureListener.scala:13-19).
+Where the reference bridges Spark's JVM TaskFailureListener to Python over
+py4j, our engine emits task-failure events in-process: recoverable incidents
+inside the executor (e.g. a partition-exchange capacity retry on the mesh)
+are fanned out to listeners registered on the Session, and a query that
+completed despite such incidents is reported `CompletedWithTaskFailures`.
+
+The summary field set and the `<prefix>-<query>-<startTime>.json` filename
+contract are kept identical so downstream report tooling ports unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable
+
+import jax
+
+from . import __version__
+
+_REDACTED = ("TOKEN", "SECRET", "PASSWORD", "PASSWD", "CREDENTIAL", "KEY")
+
+
+def engine_conf(session) -> dict:
+    """The engine's effective configuration (reference analogue: sparkConf)."""
+    conf = {
+        "engine.version": __version__,
+        "jax.version": jax.__version__,
+        "jax.backend": jax.default_backend(),
+        "jax.device_count": jax.device_count(),
+        "jax.devices": ", ".join(str(d) for d in jax.devices()),
+        "engine.use_decimal": getattr(session, "use_decimal", True),
+    }
+    conf.update(getattr(session, "conf", {}) or {})
+    return {k: str(v) for k, v in conf.items()}
+
+
+class BenchReport:
+    """Records one benchmarked callable: environment, wall-clock, status."""
+
+    def __init__(self, session) -> None:
+        self.session = session
+        self.summary = {
+            "env": {
+                "envVars": {},
+                "sparkConf": {},  # key kept for report-pipeline compatibility
+                "sparkVersion": None,
+            },
+            "queryStatus": [],
+            "exceptions": [],
+            "startTime": None,
+            "queryTimes": [],
+        }
+
+    def report_on(self, fn: Callable, *args):
+        """Run fn(*args), recording env (secrets redacted), status and time."""
+        env_vars = {
+            k: v
+            for k, v in os.environ.items()
+            if not any(tag in k.upper() for tag in _REDACTED)
+        }
+        self.summary["env"]["envVars"] = env_vars
+        self.summary["env"]["sparkConf"] = engine_conf(self.session)
+        self.summary["env"]["sparkVersion"] = f"nds-tpu {__version__}"
+        failures: list[str] = []
+        registered = False
+        try:
+            self.session.register_listener(failures.append)
+            registered = True
+        except AttributeError:
+            pass
+        start_time = int(time.time() * 1000)
+        try:
+            fn(*args)
+            end_time = int(time.time() * 1000)
+            if failures:
+                self.summary["queryStatus"].append("CompletedWithTaskFailures")
+            else:
+                self.summary["queryStatus"].append("Completed")
+        except Exception as e:  # a failed query must not abort the stream
+            print(e)
+            end_time = int(time.time() * 1000)
+            self.summary["queryStatus"].append("Failed")
+            self.summary["exceptions"].append(str(e))
+        finally:
+            if registered:
+                self.session.unregister_listener(failures.append)
+        self.summary["startTime"] = start_time
+        self.summary["queryTimes"].append(end_time - start_time)
+        if failures:
+            self.summary["taskFailures"] = list(failures)
+        return self.summary
+
+    def write_summary(self, query_name: str, prefix: str = "") -> str:
+        """Write `<prefix>-<query>-<startTime>.json` (reference keeps this
+        exact name format for its Power-BI pipeline; we keep it for parity)."""
+        self.summary["query"] = query_name
+        filename = f"{prefix}-{query_name}-{self.summary['startTime']}.json"
+        self.summary["filename"] = filename
+        with open(filename, "w") as f:
+            json.dump(self.summary, f, indent=2)
+        return filename
