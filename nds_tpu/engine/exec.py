@@ -272,6 +272,11 @@ class Executor:
             rv.append(cb.valid)
         llive = left.row_mask()
         rlive = right.row_mask()
+        fast = self._try_dense_join(
+            left, right, kind, lk, lv, rk, rv, llive, rlive, residual, mark_name
+        )
+        if fast is not None:
+            return fast
         li, ri, pl, total = K.join_candidates(lk, lv, llive, rk, rv, rlive)
         ok = K.verify_pairs(li, ri, pl, lk, lv, llive, rk, rv, rlive)
 
@@ -353,6 +358,66 @@ class Executor:
                 left, right, all_li, all_ri, total_rows, rnull, lnull
             )
         raise ExecError(f"join kind {kind}")
+
+    # -- dense-domain star-join fast path --------------------------------
+    # TPC-DS fact->dim joins hit this: single int key whose build-side
+    # domain is dense (surrogate keys). Probes are elementwise gathers, so
+    # the fact side never sorts, and under a mesh the probe stays local per
+    # chip (build side replicated). Falls back to the sort join otherwise.
+    _DENSE_MAX_DOMAIN = 1 << 22
+
+    def _try_dense_join(
+        self, left, right, kind, lk, lv, rk, rv, llive, rlive, residual, mark_name
+    ):
+        if len(lk) != 1:
+            return None
+        if kind not in ("inner", "left", "semi", "anti", "mark"):
+            return None
+        if kind in ("semi", "anti", "mark") and residual is not None:
+            return None
+        if kind == "left" and residual is not None:
+            return None
+        rnn = K._all_valid([rv[0]], rlive)
+        rkey = rk[0].astype(jnp.int64)
+        rmin, rmax = K.masked_min_max(rkey, rnn)
+        if rmin > rmax:
+            return None  # no joinable build rows; sort path handles empties
+        domain = rmax - rmin + 1
+        if domain > min(self._DENSE_MAX_DOMAIN, max(1 << 14, 8 * right.cap)):
+            return None
+        table_cap = bucket_cap(domain)
+        presence, rows, counts = K.dense_build(rkey, rnn, rmin, table_cap)
+        # inner/left require a unique build side (no output expansion);
+        # check before probing so the fallback never pays a wasted probe
+        if kind in ("inner", "left") and int(counts.max()) > 1:
+            return None
+        lnn = K._all_valid([lv[0]], llive)
+        matched, ri = K.dense_probe(
+            lk[0].astype(jnp.int64), lnn, rmin, presence, rows, table_cap
+        )
+        if kind in ("semi", "anti", "mark"):
+            if kind == "mark":
+                out_cols = dict(left.columns)
+                out_cols[mark_name] = Column(matched, BOOL)
+                return Table(out_cols, left.nrows)
+            mask = (matched if kind == "semi" else ~matched) & llive
+            return self._compact(left, mask)
+        if kind == "inner":
+            count = K.mask_count(matched)
+            sel = K.compact_indices(matched, bucket_cap(max(count, 1)))
+            pair = self._pair_table(left, right, sel, ri[sel], count, rnull=None)
+            if residual is not None:
+                return self._compact(pair, self._predicate_mask(pair, residual))
+            return pair
+        # left join: left-aligned output, unmatched rows null on the right
+        out_cols = dict(left.columns)
+        ri_safe = jnp.where(matched, ri, 0)
+        for name, c in right.columns.items():
+            valid = c.valid[ri_safe] if c.valid is not None else jnp.ones(left.cap, bool)
+            out_cols[name] = Column(
+                c.data[ri_safe], c.dtype, valid & matched, c.dictionary
+            )
+        return Table(out_cols, left.nrows)
 
     def _apply_residual(self, ok, li, ri, left, right, residual):
         count = K.mask_count(ok)
@@ -460,6 +525,13 @@ class Executor:
                 key_cols.append(ev.eval(e))
         active = [c for c in key_cols if c is not None]
 
+        if active and child.nrows > 0:
+            direct = self._try_direct_agg(
+                child, key_items, key_cols, agg_items, subset, ev, live
+            )
+            if direct is not None:
+                return direct
+
         if active:
             keys = []
             valids = []
@@ -491,6 +563,89 @@ class Executor:
             child, key_items, key_cols, agg_items, subset,
             order, gid, ngroups, ev, gcap, live_sorted,
         )
+
+    # -- direct (sort-free) aggregation ----------------------------------
+    # When the combined group-key domain is small (the TPC-DS norm), group
+    # ids are computed elementwise as mixed-radix codes and every aggregate
+    # is one scatter-add — no sort of the fact table. Under a mesh the
+    # scatter-add over row-sharded input lowers to per-chip partial
+    # aggregation + a cross-chip reduction of the small group table.
+    _DIRECT_AGG_MAX_DOMAIN = 1 << 22
+
+    def _try_direct_agg(
+        self, child, key_items, key_cols, agg_items, subset, ev, live
+    ):
+        if any(agg.distinct for agg, _ in agg_items):
+            return None
+        active = [(i, c) for i, c in enumerate(key_cols) if c is not None]
+        datas, valids, mins, ranges = [], [], [], []
+        domain = 1
+        for _, c in active:
+            if c.dtype.kind in ("float64", "float32"):
+                return None
+            data = c.data
+            if data.dtype == jnp.bool_:
+                data = data.astype(jnp.int32)
+            nn = live & c.valid if c.valid is not None else live
+            kmin, kmax = K.masked_min_max(data.astype(jnp.int64), nn)
+            if kmin > kmax:
+                return None
+            krange = kmax - kmin + 1 + (1 if c.valid is not None else 0)
+            domain *= krange
+            if domain > self._DIRECT_AGG_MAX_DOMAIN:
+                return None
+            datas.append(data)
+            valids.append(c.valid)
+            mins.append(kmin)
+            ranges.append(krange)
+        domain_cap = bucket_cap(domain)
+        gid = K.direct_gid(datas, valids, mins, ranges, live)
+        occ, dense = K.occupancy_map(gid, live, domain_cap)
+        ngroups = K.mask_count(occ)
+        if ngroups == 0:
+            return None
+        gcap = bucket_cap(ngroups)
+        gid_dense = jnp.clip(dense[gid], 0)
+        occ_cells = K.compact_indices(occ, gcap).astype(jnp.int64)
+
+        # reconstruct key columns from the occupied cell codes (reverse
+        # mixed-radix decomposition; last key is least significant)
+        codes = []
+        rem = occ_cells
+        for krange in reversed(ranges):
+            codes.append(rem % krange)
+            rem = rem // krange
+        codes.reverse()
+        cols = {}
+        ai = 0
+        for i, ((e, name), c) in enumerate(zip(key_items, key_cols)):
+            if c is None:
+                base = ev.eval(key_items[i][0])
+                cols[name] = Column(
+                    jnp.zeros(gcap, base.dtype.device_np_dtype()),
+                    base.dtype,
+                    jnp.zeros(gcap, bool),
+                    base.dictionary,
+                )
+                continue
+            code = codes[ai]
+            kmin = mins[ai]
+            ai += 1
+            if c.valid is not None:
+                valid = code != 0
+                value = jnp.where(valid, kmin + code - 1, 0)
+            else:
+                valid = None
+                value = kmin + code
+            out_dtype = c.dtype.device_np_dtype()
+            data = value.astype(out_dtype)
+            cols[name] = Column(data, c.dtype, valid, c.dictionary)
+        for agg, name in agg_items:
+            cols[name] = self._eval_agg(
+                agg, ev, None, gid_dense, gcap, live, ngroups, child, subset,
+                key_cols,
+            )
+        return Table(cols, ngroups)
 
     def _agg_output(
         self, child, key_items, key_cols, agg_items, subset,
@@ -561,12 +716,13 @@ class Executor:
             return Column(counts.astype(jnp.int64), INT64)
         c = ev.eval(agg.arg)
         weight = live_sorted
-        sdata = c.data[order]
+        # order=None: direct (unsorted) aggregation — gid/live are row-aligned
+        sdata = c.data if order is None else c.data[order]
         if c.valid is not None:
-            weight = weight & c.valid[order]
+            weight = weight & (c.valid if order is None else c.valid[order])
         if c.dtype.is_string:
             rank, sorted_dict = sort_dictionary(c)
-            sdata = rank[order]
+            sdata = rank if order is None else rank[order]
             if fn in ("min", "max"):
                 red = K.segment_reduce(sdata, gid, weight, gcap, fn)
                 counts = K.segment_reduce(sdata, gid, weight, gcap, "count")

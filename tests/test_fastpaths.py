@@ -1,0 +1,140 @@
+"""Dense-join and direct-aggregation fast paths vs the sort-based fallback:
+both physical strategies must produce identical results (the engine's AQE-ish
+plan choice must never change answers)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from nds_tpu.engine.exec import Executor
+from nds_tpu.engine.session import Session
+
+
+def _sess(seed=0, dup_keys=False, sparse=False):
+    rng = np.random.default_rng(seed)
+    n_dim, n_fact = 64, 2048
+    keys = np.arange(1, n_dim + 1, dtype=np.int64)
+    if sparse:
+        keys = keys * 1_000_003  # domain too wide for the dense table
+    if dup_keys:
+        keys[n_dim // 2 :] = keys[: n_dim // 2]  # non-unique build side
+    dim = pa.table(
+        {
+            "d_sk": keys,
+            "d_grp": rng.integers(0, 5, n_dim),
+        }
+    )
+    fact = pa.table(
+        {
+            "f_sk": rng.choice(keys, n_fact),
+            "f_val": rng.integers(0, 1000, n_fact),
+        }
+    )
+    s = Session()
+    s.register_arrow("dim", dim)
+    s.register_arrow("fact", fact)
+    return s
+
+
+QUERIES = [
+    "select d_grp, sum(f_val) s, count(*) c from fact, dim where f_sk = d_sk group by d_grp order by d_grp",
+    "select count(*) c from fact where f_sk in (select d_sk from dim where d_grp = 2)",
+    "select count(*) c from fact where f_sk not in (select d_sk from dim where d_grp = 2)",
+    "select d_grp, count(*) c from fact left join dim on f_sk = d_sk group by d_grp order by d_grp",
+]
+
+
+@pytest.mark.parametrize("variant", ["plain", "dup_keys", "sparse"])
+@pytest.mark.parametrize("qi", range(len(QUERIES)))
+def test_fast_and_fallback_agree(variant, qi, monkeypatch):
+    s = _sess(dup_keys=variant == "dup_keys", sparse=variant == "sparse")
+    q = QUERIES[qi]
+    fast = s.sql(q).collect()
+    # force the sort-based paths
+    monkeypatch.setattr(Executor, "_DENSE_MAX_DOMAIN", 0)
+    monkeypatch.setattr(Executor, "_DIRECT_AGG_MAX_DOMAIN", 0)
+    slow = s.sql(q).collect()
+    assert fast.num_rows == slow.num_rows
+    for col in fast.schema.names:
+        assert fast.column(col).to_pylist() == slow.column(col).to_pylist(), (
+            variant,
+            q,
+            col,
+        )
+
+
+def test_direct_agg_null_keys(monkeypatch):
+    rng = np.random.default_rng(3)
+    n = 512
+    vals = rng.integers(0, 50, n)
+    grp = np.where(rng.random(n) < 0.2, None, rng.integers(0, 4, n).astype(object))
+    t = pa.table({"g": pa.array(grp, type=pa.int64()), "v": vals})
+    s = Session()
+    s.register_arrow("t", t)
+    q = "select g, count(*) c, sum(v) sv, min(v) mn from t group by g order by g"
+    fast = s.sql(q).collect()
+    monkeypatch.setattr(Executor, "_DIRECT_AGG_MAX_DOMAIN", 0)
+    slow = s.sql(q).collect()
+    assert fast.to_pylist() == slow.to_pylist()
+
+
+def test_direct_agg_string_and_bool_keys(monkeypatch):
+    rng = np.random.default_rng(4)
+    n = 512
+    t = pa.table(
+        {
+            "s": pa.array(rng.choice(["a", "b", None], n)),
+            "b": pa.array(rng.random(n) < 0.5),
+            "v": rng.integers(0, 50, n),
+        }
+    )
+    s = Session()
+    s.register_arrow("t", t)
+    q = "select s, b, count(*) c, sum(v) sv from t group by s, b order by s, b"
+    fast = s.sql(q).collect()
+    monkeypatch.setattr(Executor, "_DIRECT_AGG_MAX_DOMAIN", 0)
+    slow = s.sql(q).collect()
+    assert fast.to_pylist() == slow.to_pylist()
+
+
+def test_oom_retry_reloads_all_requested_columns(monkeypatch):
+    """A RESOURCE_EXHAUSTED mid-load must drop caches and reload the FULL
+    requested column set (not just the missing subset), and surface a
+    task-failure event."""
+    t = pa.table({"a": np.arange(8, dtype=np.int64), "b": np.arange(8, dtype=np.int64)})
+    s = Session()
+    s.register_arrow("t", t)
+    s.catalog.load("t", ["a"])  # cache column a
+    failures = []
+    s.register_listener(failures.append)
+    from nds_tpu.engine.session import Catalog
+
+    real = Catalog._to_device
+    calls = {"n": 0}
+
+    def flaky(self, name, arrow, e):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+        return real(self, name, arrow, e)
+
+    monkeypatch.setattr(Catalog, "_to_device", flaky)
+    out = s.catalog.load("t", ["a", "b"])
+    assert set(out.columns) == {"a", "b"}
+    assert failures and "device memory exhausted" in failures[0]
+
+
+def test_negative_keys(monkeypatch):
+    t = pa.table(
+        {
+            "g": np.array([-5, -5, -3, 0, 2, 2, -3], dtype=np.int64),
+            "v": np.arange(7, dtype=np.int64),
+        }
+    )
+    s = Session()
+    s.register_arrow("t", t)
+    q = "select g, sum(v) sv from t group by g order by g"
+    fast = s.sql(q).collect()
+    monkeypatch.setattr(Executor, "_DIRECT_AGG_MAX_DOMAIN", 0)
+    slow = s.sql(q).collect()
+    assert fast.to_pylist() == slow.to_pylist()
