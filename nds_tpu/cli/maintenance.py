@@ -1,0 +1,56 @@
+"""Data Maintenance CLI (reference: nds/nds_maintenance.py __main__ :273-316).
+
+    python -m nds_tpu.cli.maintenance <warehouse_path> <refresh_data_path>
+        <time_log> [--maintenance_queries LF_CS,DF_CS] [--property_file F]
+        [--json_summary_folder DIR] [--floats]
+"""
+
+import argparse
+
+from ..check import check_version
+from ..maintenance import run_maintenance
+
+
+def main(argv=None):
+    check_version()
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "warehouse_path", help="lakehouse warehouse root to apply refreshes to"
+    )
+    parser.add_argument(
+        "refresh_data_path", help="path to the generated refresh (--update) data"
+    )
+    parser.add_argument(
+        "time_log", help="path to execution time log (CSV)", default=""
+    )
+    parser.add_argument(
+        "--maintenance_queries",
+        type=lambda s: s.split(","),
+        help="comma separated maintenance function names, e.g. 'LF_CS,DF_CS'",
+    )
+    parser.add_argument(
+        "--property_file", help="property file for engine configuration"
+    )
+    parser.add_argument(
+        "--json_summary_folder",
+        help="empty folder (created if missing) for per-function JSON summaries",
+    )
+    parser.add_argument(
+        "--floats",
+        action="store_true",
+        help="use double instead of decimal for decimal-typed columns",
+    )
+    args = parser.parse_args(argv)
+    run_maintenance(
+        warehouse_path=args.warehouse_path,
+        refresh_data_path=args.refresh_data_path,
+        time_log_output_path=args.time_log,
+        json_summary_folder=args.json_summary_folder,
+        property_file=args.property_file,
+        spec_queries=args.maintenance_queries,
+        use_decimal=not args.floats,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,25 @@
+"""Snapshot rollback CLI (reference: nds/nds_rollback.py __main__ :54-60).
+
+    python -m nds_tpu.cli.rollback <warehouse_path> <timestamp>
+
+Restores the maintenance-mutated fact tables to their last snapshot at or
+before <timestamp> ('YYYY-mm-dd HH:MM:SS[.f]').
+"""
+
+import argparse
+
+from ..check import check_version
+from ..maintenance import rollback
+
+
+def main(argv=None):
+    check_version()
+    parser = argparse.ArgumentParser()
+    parser.add_argument("warehouse_path", help="lakehouse warehouse root")
+    parser.add_argument("timestamp", help="timestamp to roll back to")
+    args = parser.parse_args(argv)
+    rollback(args.warehouse_path, args.timestamp)
+
+
+if __name__ == "__main__":
+    main()

@@ -618,9 +618,21 @@ class Binder:
         marked_replacements = {}
         for sub in subs:
             if sub.kind == "scalar":
-                raise BindError(
-                    "correlated scalar subquery under OR is not supported"
+                inner_plan, joins = self._bind_correlated(sub.query, scope, views)
+                if joins:
+                    raise BindError(
+                        "correlated scalar subquery under OR is not supported"
+                    )
+                # uncorrelated: inline as a broadcast scalar (pre-bound, so
+                # protect it behind a placeholder like the NOT IN lowering)
+                sc = E.ScalarSubquery(
+                    plan=inner_plan, out_name=self._subquery_out_cols[0][0]
                 )
+                placeholder = E.Col(self.fresh("_sqv"))
+                marked_replacements[placeholder.name] = sc
+                marks.add(placeholder.name)
+                rewritten = _replace_node(rewritten, sub, placeholder)
+                continue
             inner_plan, joins = self._bind_correlated(sub.query, scope, views)
             sub_cols = self._subquery_out_cols
             if sub.kind == "in" and sub.negated:

@@ -91,7 +91,9 @@ class Table:
     def cap(self) -> int:
         for c in self.columns.values():
             return int(c.data.shape[0])
-        return 0
+        # column-less table (e.g. the __dual__ relation for FROM-less
+        # selects): capacity must still cover the live rows
+        return bucket_cap(self.nrows) if self.nrows > 0 else 0
 
     @property
     def names(self):

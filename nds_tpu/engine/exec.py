@@ -439,9 +439,15 @@ class Executor:
 
     def _join_key_pair(self, a: Column, b: Column):
         """Align join key dtypes (incl. cross-dictionary string unification)."""
+        if a.dtype.is_string != b.dtype.is_string:
+            # implicit coercion (Spark casts the string side): parse the
+            # string key as the other side's type, e.g. invn_date = d_date
+            # in the LF_I maintenance function
+            if a.dtype.is_string:
+                a = _cast_column(a, b.dtype, a.data.shape[0])
+            else:
+                b = _cast_column(b, a.dtype, b.data.shape[0])
         if a.dtype.is_string or b.dtype.is_string:
-            if not (a.dtype.is_string and b.dtype.is_string):
-                raise ExecError("join key type mismatch string/non-string")
             ca, cb, uni = unify_dictionaries(a, b)
             return (
                 Column(ca, a.dtype, a.valid, uni),

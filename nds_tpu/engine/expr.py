@@ -747,26 +747,53 @@ def _cast_column(c: Column, target: DType, cap: int) -> Column:
             enc.dictionary,
         )
     if src.is_string:
-        # string -> numeric/date: parse the dictionary on host, gather codes
+        # string -> numeric/date: parse the dictionary on host, gather codes.
+        # Unparseable entries become NULL (Spark cast semantics), not 0 —
+        # a garbage date must never join date_dim's epoch row.
         d = c.dictionary.cast(pa.string())
-        if target.kind == "date":
-            lut = np.array(
-                [date_to_days(s) if s and _DATE_RE.match(s) else 0 for s in np.asarray(d).tolist()],
-                dtype=np.int32,
+        entries = np.asarray(d).tolist()
+        if not entries:
+            npdt = (
+                np.int32
+                if target.kind == "date"
+                else np.int64 if target.is_decimal else target.device_np_dtype()
             )
-        elif target.is_decimal:
-            lut = np.array(
-                [int(round(float(s or 0) * 10**target.scale)) for s in np.asarray(d).tolist()],
-                dtype=np.int64,
+            n = c.data.shape[0]
+            return Column(
+                jnp.zeros(n, npdt), target, jnp.zeros(n, bool)
             )
-        else:
-            npdt = target.device_np_dtype()
-            lut = np.array(
-                [npdt(float(s)) if s not in (None, "") else npdt(0) for s in np.asarray(d).tolist()],
-                dtype=npdt,
-            )
-        data = jnp.asarray(lut)[jnp.clip(c.data, 0, max(len(d) - 1, 0))]
-        return Column(data, target, c.valid)
+        lut = []
+        lut_ok = []
+        for s in entries:
+            try:
+                if s is None or (isinstance(s, str) and not s.strip()):
+                    raise ValueError
+                s = s.strip() if isinstance(s, str) else s
+                if target.kind == "date":
+                    if not _DATE_RE.match(s):
+                        raise ValueError
+                    v = date_to_days(s)
+                elif target.is_decimal:
+                    v = int(round(float(s) * 10**target.scale))
+                else:
+                    v = target.device_np_dtype()(float(s))
+                lut.append(v)
+                lut_ok.append(True)
+            except (ValueError, TypeError):
+                lut.append(0)
+                lut_ok.append(False)
+        npdt = (
+            np.int32
+            if target.kind == "date"
+            else np.int64 if target.is_decimal else target.device_np_dtype()
+        )
+        lut = np.asarray(lut, dtype=npdt)
+        lut_ok = np.asarray(lut_ok, dtype=bool)
+        codes = jnp.clip(c.data, 0, max(len(entries) - 1, 0))
+        data = jnp.asarray(lut)[codes]
+        parsed = jnp.asarray(lut_ok)[codes] if not lut_ok.all() else None
+        valid = _and_valid(c.valid, parsed)
+        return Column(data, target, valid)
     if target.is_decimal:
         if src.is_decimal:
             shift = target.scale - src.scale

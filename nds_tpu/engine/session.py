@@ -59,6 +59,10 @@ class Catalog:
         # hive partitioning discovery: the transcode phase writes fact tables
         # as <date_sk>=<value>/ directories; declare the partition field type
         # from the table schema so keys round-trip with the right dtype
+        if e.fmt == "lakehouse":
+            from ..lakehouse.table import LakehouseTable
+
+            return LakehouseTable(e.path).dataset()
         part = "hive"
         fmt = e.fmt
         if e.schema is not None:
@@ -265,6 +269,13 @@ class Session:
         hive-partitioned) — lazy, like parquet registration."""
         self.catalog.entries[name.lower()] = _Entry(
             schema=schema, path=path, fmt="csv"
+        )
+
+    def register_lakehouse(self, name, path, schema=None):
+        """Snapshot-manifest (ACID) table — the Iceberg/Delta-equivalent
+        warehouse format used by the Data Maintenance phase."""
+        self.catalog.entries[name.lower()] = _Entry(
+            schema=schema, path=path, fmt="lakehouse"
         )
 
     def register_nds_tables(self, data_root, fmt="parquet", maintenance=False):

@@ -613,6 +613,15 @@ class Parser:
                 return E.Lit(s, parse_dtype("date"))
             self.next()
             return E.Col("date")
+        if (
+            self.peek().kind == "id"
+            and self.peek().value == "timestamp"
+            and self.peek(1).kind == "str"
+        ):
+            # TIMESTAMP '...' literal (CALL rollback_to_timestamp syntax,
+            # reference: nds/nds_rollback.py:46-51); kept as a plain string
+            self.next()
+            return E.Lit(self.next().value)
         if self.at_kw("exists"):
             return self.predicate()
         if self.at_kw("grouping"):

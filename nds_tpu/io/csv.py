@@ -34,7 +34,16 @@ def _convert_options(schema, use_decimal):
     )
 
 
+def _empty_table(schema, use_decimal):
+    return pa.table(
+        {f.name: pa.array([], type=f.dtype.to_arrow(use_decimal)) for f in schema}
+    )
+
+
 def read_dat_file(path, schema, use_decimal=True) -> pa.Table:
+    if os.path.getsize(path) == 0:
+        # small scale factors legitimately produce empty refresh chunks
+        return _empty_table(schema, use_decimal)
     t = pacsv.read_csv(
         path,
         read_options=_read_options(schema),
@@ -72,6 +81,8 @@ def iter_dat_batches(path, schema, use_decimal=True, block_size=64 << 20):
     ropts = _read_options(schema)
     ropts.block_size = block_size
     for f in files:
+        if os.path.getsize(f) == 0:
+            continue
         with pacsv.open_csv(
             f,
             read_options=ropts,

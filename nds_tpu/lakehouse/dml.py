@@ -1,0 +1,166 @@
+"""DML execution over lakehouse tables: INSERT / DELETE / CTAS / CALL.
+
+The reference's Data Maintenance phase issues these against Iceberg/Delta
+through Spark SQL (reference: nds/nds_maintenance.py:188-202 run_dm_query,
+nds/data_maintenance/LF_SS.sql:31-68, DF_SS.sql:30-33, nds/nds_rollback.py:46-51).
+Here they execute through the engine and commit snapshots to the manifest
+log. DELETE keeps rows whose predicate is not TRUE (SQL three-valued
+semantics: a NULL predicate row survives), implemented as a copy-on-write
+rewrite of the surviving rows.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..engine import expr as E
+from ..engine.sql import ast as A
+from .table import LakehouseError, LakehouseTable
+
+
+class DmlResult:
+    """Mirrors the tiny surface of engine.session.Result the harness uses."""
+
+    def __init__(self, rows_affected: int, version: int | None = None):
+        self.rows_affected = rows_affected
+        self.version = version
+
+    def collect(self):
+        import pyarrow as pa
+
+        return pa.table({"rows_affected": [self.rows_affected]})
+
+    def num_rows(self):
+        return 1
+
+
+def _lake_table(session, name: str) -> LakehouseTable:
+    entry = session.catalog.entries.get(name.lower())
+    if entry is None or entry.path is None:
+        raise LakehouseError(
+            f"{name!r} is not a lakehouse table registered on this session"
+        )
+    return LakehouseTable(entry.path)
+
+
+def run_dml(session, stmt):
+    if isinstance(stmt, A.InsertStmt):
+        return _run_insert(session, stmt)
+    if isinstance(stmt, A.DeleteStmt):
+        return _run_delete(session, stmt)
+    if isinstance(stmt, A.CreateTableStmt):
+        return _run_ctas(session, stmt)
+    if isinstance(stmt, A.CallStmt):
+        return _run_call(session, stmt)
+    raise TypeError(f"unsupported DML statement {type(stmt).__name__}")
+
+
+def _cast_to_schema(rows, target):
+    """Positional insert-cast with Spark-like leniency: decimal rescale and
+    float narrowing truncate instead of erroring."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    cols = []
+    for i, field in enumerate(target):
+        col = rows.column(i)
+        if col.type != field.type:
+            col = pc.cast(
+                col,
+                options=pc.CastOptions(
+                    target_type=field.type,
+                    allow_decimal_truncate=True,
+                    allow_float_truncate=True,
+                ),
+            )
+        cols.append(col)
+    return pa.table(cols, schema=target)
+
+
+def _run_insert(session, stmt: A.InsertStmt):
+    table = _lake_table(session, stmt.table)
+    rows = session.run_stmt(stmt.query).collect()
+    target = table.schema()
+    if target is not None:
+        rows = _cast_to_schema(rows, target)
+    version = table.append(rows, operation="insert")
+    session.catalog.invalidate(stmt.table.lower())
+    return DmlResult(rows.num_rows, version)
+
+
+def _run_delete(session, stmt: A.DeleteStmt):
+    table = _lake_table(session, stmt.table)
+    before = table.dataset().count_rows()
+    if stmt.where is None:
+        keep = None  # DELETE FROM t -> truncate
+    else:
+        # survivors: rows where the predicate is FALSE or NULL
+        keep = E.UnaryOp(
+            "not", E.Func("coalesce", (stmt.where, E.Lit(False)))
+        )
+    query = A.SelectStmt(
+        select_items=[("*", None)],
+        from_items=[A.TableRef(stmt.table)],
+        where=keep,
+    )
+    if keep is None:
+        target = table.schema()
+        if target is None:
+            raise LakehouseError(f"{stmt.table}: table has no schema")
+        survivors = target.empty_table()
+    else:
+        survivors = session.run_stmt(query).collect()
+        target = table.schema()
+        if target is not None:
+            survivors = _cast_to_schema(survivors, target)
+    version = table.replace(survivors, operation="delete")
+    session.catalog.invalidate(stmt.table.lower())
+    return DmlResult(before - survivors.num_rows, version)
+
+
+def _run_ctas(session, stmt: A.CreateTableStmt):
+    rows = session.run_stmt(stmt.query).collect()
+    location = stmt.location
+    if location is None:
+        root = session.conf.get("lakehouse.warehouse")
+        if root is None:
+            raise LakehouseError(
+                "CREATE TABLE needs a LOCATION or session conf "
+                "'lakehouse.warehouse'"
+            )
+        location = os.path.join(root, stmt.name.lower())
+    LakehouseTable.create(location, rows)
+    session.register_lakehouse(stmt.name, location)
+    return DmlResult(rows.num_rows, 1)
+
+
+def _run_call(session, stmt: A.CallStmt):
+    proc = stmt.procedure.rsplit(".", 1)[-1].lower()
+    if proc != "rollback_to_timestamp":
+        raise LakehouseError(f"unknown procedure {stmt.procedure}")
+    def unwrap(a):
+        return a.value if isinstance(a, E.Lit) else a
+
+    table_name, ts = unwrap(stmt.args[0]), unwrap(stmt.args[1])
+    table = _lake_table(session, str(table_name))
+    ts_ms = _to_ts_ms(ts)
+    version = table.rollback_to_timestamp(ts_ms)
+    session.catalog.invalidate(str(table_name).lower())
+    return DmlResult(0, version)
+
+
+def _to_ts_ms(ts) -> int:
+    if isinstance(ts, (int, float)):
+        # numeric: epoch seconds (fractional ok) or ms if large
+        return int(ts if ts > 10**12 else ts * 1000)
+    from datetime import datetime
+
+    s = str(ts).strip()
+    for fmt in ("%Y-%m-%d %H:%M:%S.%f", "%Y-%m-%d %H:%M:%S", "%Y-%m-%d"):
+        try:
+            # naive timestamps are local time, like the snapshot log prints
+            dt = datetime.strptime(s, fmt)
+            return int(dt.timestamp() * 1000)
+        except ValueError:
+            continue
+    raise LakehouseError(f"cannot parse timestamp {ts!r}")

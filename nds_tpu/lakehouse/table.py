@@ -1,0 +1,217 @@
+"""Snapshot-manifest table layer: the Iceberg/Delta-equivalent ACID surface.
+
+The reference runs Data Maintenance against Iceberg or Delta Lake warehouses
+(reference: nds/nds_maintenance.py:118-202, nds/nds_rollback.py:46-51). The
+TPU framework needs the same capabilities — atomic INSERT/DELETE, snapshot
+history, timestamp rollback — without a JVM catalog service. This layer
+provides them with immutable parquet data files plus a JSON manifest log:
+
+    <table>/
+      data/part-<version>-<n>.parquet      (immutable)
+      _manifests/v000001.json ...          (one per snapshot)
+
+A snapshot lists the data files that constitute the table at that version.
+Writers stage data files first, then commit by writing the next manifest
+(atomic via os.rename), so readers always see a consistent snapshot.
+Rollback appends a new manifest replaying an older file list — history is
+never rewritten, matching Iceberg's rollback_to_timestamp semantics.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+
+import pyarrow as pa
+import pyarrow.dataset as pads
+import pyarrow.parquet as pq
+
+_MANIFEST_DIR = "_manifests"
+_DATA_DIR = "data"
+
+
+class LakehouseError(Exception):
+    pass
+
+
+class LakehouseTable:
+    def __init__(self, path: str):
+        self.path = path
+        self.manifest_dir = os.path.join(path, _MANIFEST_DIR)
+        self.data_dir = os.path.join(path, _DATA_DIR)
+        if not os.path.isdir(self.manifest_dir):
+            raise LakehouseError(f"{path} is not a lakehouse table")
+
+    # -- creation ----------------------------------------------------------
+    @classmethod
+    def create(cls, path: str, batches=None, schema: pa.Schema | None = None):
+        """Create an empty table (or one seeded from an iterable of record
+        batches / a pa.Table)."""
+        os.makedirs(os.path.join(path, _MANIFEST_DIR), exist_ok=True)
+        os.makedirs(os.path.join(path, _DATA_DIR), exist_ok=True)
+        t = cls(path)
+        staged = t._stage(batches, schema) if batches is not None else []
+        if schema is None and staged:
+            schema = pq.read_schema(os.path.join(path, staged[0][0]))
+        t._commit(staged, "create", base_files=[], schema=schema)
+        return t
+
+    @classmethod
+    def is_table(cls, path: str) -> bool:
+        return os.path.isdir(os.path.join(path, _MANIFEST_DIR))
+
+    # -- snapshot log ------------------------------------------------------
+    def versions(self):
+        """[(version, timestamp_ms, operation)] ascending."""
+        out = []
+        for f in sorted(os.listdir(self.manifest_dir)):
+            if f.startswith("v") and f.endswith(".json"):
+                with open(os.path.join(self.manifest_dir, f)) as fh:
+                    m = json.load(fh)
+                out.append((m["version"], m["timestamp_ms"], m["operation"]))
+        return out
+
+    def _manifest(self, version: int) -> dict:
+        p = os.path.join(self.manifest_dir, f"v{version:06d}.json")
+        with open(p) as fh:
+            return json.load(fh)
+
+    def current_version(self) -> int:
+        vs = [v for v, _, _ in self.versions()]
+        if not vs:
+            raise LakehouseError(f"{self.path}: no snapshots")
+        return max(vs)
+
+    def current_files(self):
+        m = self._manifest(self.current_version())
+        return [os.path.join(self.path, f) for f in m["files"]]
+
+    def num_rows(self) -> int:
+        m = self._manifest(self.current_version())
+        return m.get("num_rows", -1)
+
+    # -- reads -------------------------------------------------------------
+    def dataset(self) -> pads.Dataset:
+        files = self.current_files()
+        if not files:
+            # empty snapshot: in-memory empty dataset over the stored schema
+            schema = self.schema()
+            if schema is None:
+                raise LakehouseError(f"{self.path}: empty table with no schema")
+            return pads.dataset(schema.empty_table())
+        return pads.dataset(files, format="parquet")
+
+    def schema(self) -> pa.Schema | None:
+        files = self.current_files()
+        if files:
+            return pq.read_schema(files[0])
+        m = self._manifest(self.current_version())
+        if m.get("schema_hex"):
+            # an all-rows DELETE leaves zero data files; the manifest still
+            # carries the schema so the table stays readable
+            import pyarrow.ipc as ipc
+
+            return ipc.read_schema(
+                pa.BufferReader(bytes.fromhex(m["schema_hex"]))
+            )
+        return None
+
+    # -- writes ------------------------------------------------------------
+    def _stage(self, batches, schema=None):
+        """Write data files; returns [(relpath, num_rows)]. Not yet visible."""
+        if isinstance(batches, pa.Table):
+            batches = batches.to_batches(max_chunksize=1 << 20)
+        staged = []
+        writer = None
+        relpath = None
+        n_rows = 0
+        try:
+            for b in batches:
+                if writer is None:
+                    relpath = os.path.join(
+                        _DATA_DIR, f"part-{uuid.uuid4().hex[:12]}.parquet"
+                    )
+                    writer = pq.ParquetWriter(
+                        os.path.join(self.path, relpath),
+                        schema or b.schema,
+                        compression="snappy",
+                    )
+                writer.write_batch(b)
+                n_rows += b.num_rows
+        finally:
+            if writer is not None:
+                writer.close()
+        if relpath is not None:
+            staged.append((relpath, n_rows))
+        return staged
+
+    def _commit(self, staged, operation, base_files=None, num_rows=None, schema=None):
+        """Append the next manifest: base file list + staged files."""
+        schema_hex = None
+        if schema is not None:
+            schema_hex = bytes(schema.serialize()).hex()
+        try:
+            cur = self._manifest(self.current_version())
+            version = cur["version"] + 1
+            base = cur["files"] if base_files is None else base_files
+            base_rows = cur.get("num_rows", 0) if base_files is None else 0
+            prev_ts = cur["timestamp_ms"]
+            if schema_hex is None:
+                schema_hex = cur.get("schema_hex")
+        except LakehouseError:
+            version, base, base_rows, prev_ts = 1, base_files or [], 0, 0
+        files = list(base) + [p for p, _ in staged]
+        total = (
+            num_rows
+            if num_rows is not None
+            else base_rows + sum(n for _, n in staged)
+        )
+        manifest = {
+            "version": version,
+            # strictly monotonic so timestamp rollback can never tie between
+            # adjacent snapshots (Iceberg has the same guarantee)
+            "timestamp_ms": max(int(time.time() * 1000), prev_ts + 1),
+            "operation": operation,
+            "files": files,
+            "num_rows": total,
+            "schema_hex": schema_hex,
+        }
+        tmp = os.path.join(self.manifest_dir, f".tmp-{uuid.uuid4().hex}.json")
+        with open(tmp, "w") as fh:
+            json.dump(manifest, fh)
+        os.rename(tmp, os.path.join(self.manifest_dir, f"v{version:06d}.json"))
+        return version
+
+    def append(self, table, operation="append") -> int:
+        """INSERT: add rows (pa.Table or batch iterable) as new immutable
+        files; returns the new version."""
+        staged = self._stage(table)
+        return self._commit(staged, operation)
+
+    def replace(self, table: pa.Table, operation="overwrite") -> int:
+        """Replace the full file set (copy-on-write DELETE/UPDATE)."""
+        staged = self._stage(table)
+        return self._commit(
+            staged, operation, base_files=[],
+            num_rows=sum(n for _, n in staged),
+        )
+
+    # -- time travel -------------------------------------------------------
+    def rollback_to_version(self, version: int) -> int:
+        m = self._manifest(version)
+        return self._commit(
+            [], f"rollback-to-v{version}", base_files=m["files"],
+            num_rows=m.get("num_rows"),
+        )
+
+    def rollback_to_timestamp(self, ts_ms: int) -> int:
+        """Roll back to the last snapshot at or before ts_ms (reference:
+        CALL spark_catalog.system.rollback_to_timestamp, nds_rollback.py:46-51)."""
+        candidates = [v for v, t, _ in self.versions() if t <= ts_ms]
+        if not candidates:
+            raise LakehouseError(
+                f"{self.path}: no snapshot at or before {ts_ms}"
+            )
+        return self.rollback_to_version(max(candidates))

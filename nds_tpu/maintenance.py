@@ -1,0 +1,218 @@
+"""Data Maintenance phase: the 11 TPC-DS refresh functions over the lakehouse.
+
+TPU-native counterpart of the reference maintenance driver (reference:
+nds/nds_maintenance.py — function lists :45-58, get_delete_date :60-73,
+replace_date :75-96, get_maintenance_queries :118-144, run_query :204-265,
+register_temp_views :267-271). The warehouse is our snapshot-manifest
+lakehouse (Iceberg/Delta analogue); the refresh staging tables register
+straight from the generated `--update` CSV data.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+import time
+from datetime import datetime
+
+from .check import check_json_summary_folder
+from .engine.session import Session
+from .power import load_properties
+from .report import BenchReport
+from .schema import get_maintenance_schemas, get_schemas
+
+INSERT_FUNCS = ["LF_CR", "LF_CS", "LF_I", "LF_SR", "LF_SS", "LF_WR", "LF_WS"]
+DELETE_FUNCS = ["DF_CS", "DF_SS", "DF_WS"]
+INVENTORY_DELETE_FUNC = ["DF_I"]
+DM_FUNCS = INSERT_FUNCS + DELETE_FUNCS + INVENTORY_DELETE_FUNC
+
+MAINTENANCE_SQL_DIR = os.path.join(os.path.dirname(__file__), "data_maintenance")
+
+
+def get_valid_query_names(spec_queries):
+    if spec_queries:
+        for q in spec_queries:
+            if q not in DM_FUNCS:
+                raise Exception(
+                    f"invalid Data Maintenance query: {q}. Valid are: {DM_FUNCS}"
+                )
+        return spec_queries
+    return list(DM_FUNCS)
+
+
+def get_delete_date(session):
+    """Delete-date tuples from the generated delete tables (3 per function,
+    TPC-DS spec 5.3.11)."""
+    date_dict = {}
+    for table in ("delete", "inventory_delete"):
+        rows = session.sql(f"select * from {table}").collect().to_pylist()
+        date_dict[table] = [(r["date1"], r["date2"]) for r in rows]
+    return date_dict
+
+
+def replace_date(query_list, date_tuple_list):
+    """Apply every (DATE1, DATE2) tuple to the query list, normalizing tuple
+    order so DATE1 <= DATE2."""
+    q_updated = []
+    for date1, date2 in date_tuple_list:
+        d1 = datetime.strptime(str(date1), "%Y-%m-%d")
+        d2 = datetime.strptime(str(date2), "%Y-%m-%d")
+        earlier, later = (date1, date2) if d1 <= d2 else (date2, date1)
+        for q in query_list:
+            q_updated.append(
+                q.replace("DATE1", str(earlier)).replace("DATE2", str(later))
+            )
+    return q_updated
+
+
+def get_maintenance_queries(session, folder, valid_queries):
+    """{function name: [statements]} with delete dates substituted."""
+    delete_date_dict = get_delete_date(session)
+    q_dict = {}
+    for q in valid_queries:
+        with open(os.path.join(folder, q + ".sql")) as f:
+            text = f.read()
+        stmts = [
+            s.strip() + ";"
+            for s in text.split(";")
+            if s.strip() and not all(
+                line.strip().startswith("--") or not line.strip()
+                for line in s.splitlines()
+            )
+        ]
+        if q in DELETE_FUNCS:
+            stmts = replace_date(stmts, delete_date_dict["delete"])
+        if q in INVENTORY_DELETE_FUNC:
+            stmts = replace_date(stmts, delete_date_dict["inventory_delete"])
+        q_dict[q] = stmts
+    return q_dict
+
+
+def run_dm_query(session, query_list, query_name):
+    for q in query_list:
+        session.run_script(q)
+
+
+# staging tables each refresh function reads (spec 5.3.11); the delete-date
+# tables are always needed for DATE1/DATE2 substitution
+_FUNC_STAGING = {
+    "LF_SS": ["s_purchase", "s_purchase_lineitem"],
+    "LF_SR": ["s_store_returns"],
+    "LF_CS": ["s_catalog_order", "s_catalog_order_lineitem"],
+    "LF_CR": ["s_catalog_returns"],
+    "LF_WS": ["s_web_order", "s_web_order_lineitem"],
+    "LF_WR": ["s_web_returns"],
+    "LF_I": ["s_inventory"],
+}
+
+
+def register_refresh_views(session, refresh_data_path, valid_queries=None):
+    """Register the s_* staging tables + delete tables from raw CSV
+    (reference: nds_maintenance.register_temp_views :267-271). Only the
+    staging tables the selected functions read are materialized."""
+    needed = {"delete", "inventory_delete"}
+    for q in valid_queries or DM_FUNCS:
+        needed.update(_FUNC_STAGING.get(q, []))
+    schemas = get_maintenance_schemas(session.use_decimal)
+    for table in sorted(needed):
+        path = os.path.join(refresh_data_path, table)
+        if os.path.isdir(path):
+            session.register_csv_dir(table, path, schemas[table])
+
+
+def run_maintenance(
+    warehouse_path,
+    refresh_data_path,
+    time_log_output_path,
+    json_summary_folder=None,
+    property_file=None,
+    spec_queries=None,
+    use_decimal=True,
+    maintenance_sql_dir=None,
+):
+    """Run the maintenance functions with per-function timing + reports.
+
+    Returns the Data Maintenance Time in seconds (Tdm contribution)."""
+    valid_queries = get_valid_query_names(spec_queries)
+    app_name = (
+        "NDS - Data Maintenance - " + valid_queries[0]
+        if len(valid_queries) == 1
+        else "NDS - Data Maintenance"
+    )
+    conf = {"app.name": app_name, "lakehouse.warehouse": warehouse_path}
+    if property_file:
+        conf.update(load_properties(property_file))
+    check_json_summary_folder(json_summary_folder)
+    session = Session(use_decimal=use_decimal, conf=conf)
+    app_id = f"nds-tpu-dm-{os.getpid()}-{int(time.time())}"
+
+    # warehouse fact/dim tables (lakehouse) + refresh staging views (csv)
+    session.register_nds_tables(warehouse_path, fmt="lakehouse")
+    register_refresh_views(session, refresh_data_path, valid_queries)
+
+    query_dict = get_maintenance_queries(
+        session, maintenance_sql_dir or MAINTENANCE_SQL_DIR, valid_queries
+    )
+
+    execution_time_list = []
+    total_time_start = datetime.now()
+    dm_start = datetime.now()
+    for query_name, q_content in query_dict.items():
+        print(f"====== Run {query_name} ======")
+        q_report = BenchReport(session)
+        summary = q_report.report_on(
+            run_dm_query, session, q_content, query_name
+        )
+        print(f"Time taken: {summary['queryTimes']} millis for {query_name}")
+        execution_time_list.append((app_id, query_name, summary["queryTimes"]))
+        if json_summary_folder:
+            if property_file:
+                summary_prefix = os.path.join(
+                    json_summary_folder,
+                    os.path.basename(property_file).split(".")[0],
+                )
+            else:
+                summary_prefix = os.path.join(json_summary_folder, "")
+            q_report.write_summary(query_name, prefix=summary_prefix)
+    dm_end = datetime.now()
+    dm_elapse = (dm_end - dm_start).total_seconds()
+    total_elapse = (dm_end - total_time_start).total_seconds()
+    print(f"====== Data Maintenance Start Time: {dm_start}")
+    print(f"====== Data Maintenance Time: {dm_elapse} s ======")
+    print(f"====== Total Time: {total_elapse} s ======")
+    execution_time_list.append((app_id, "Data Maintenance Start Time", dm_start))
+    execution_time_list.append((app_id, "Data Maintenance End Time", dm_end))
+    execution_time_list.append((app_id, "Data Maintenance Time", dm_elapse))
+    execution_time_list.append((app_id, "Total Time", total_elapse))
+
+    header = ["application_id", "query", "time/s"]
+    with open(time_log_output_path, "w", encoding="UTF8", newline="") as f:
+        writer = csv.writer(f)
+        writer.writerow(header)
+        writer.writerows(execution_time_list)
+    return dm_elapse
+
+
+def rollback(warehouse_path, timestamp, tables=None):
+    """Roll the mutated fact tables back to a pre-maintenance snapshot
+    (reference: nds/nds_rollback.py:37-51)."""
+    from .lakehouse.table import LakehouseTable
+
+    tables = tables or [
+        "catalog_sales",
+        "catalog_returns",
+        "inventory",
+        "store_returns",
+        "store_sales",
+        "web_returns",
+        "web_sales",
+    ]
+    session = Session(conf={"lakehouse.warehouse": warehouse_path})
+    session.register_nds_tables(warehouse_path, fmt="lakehouse")
+    for table in tables:
+        if not LakehouseTable.is_table(os.path.join(warehouse_path, table)):
+            continue
+        print(f"Rolling back {table} to {timestamp}")
+        session.sql(
+            f"call system.rollback_to_timestamp('{table}', timestamp '{timestamp}')"
+        )

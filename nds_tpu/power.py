@@ -64,6 +64,8 @@ def setup_tables(session, input_prefix, input_format, use_decimal, execution_tim
                 session.register_csv_warehouse(table_name, table_path, schema)
         elif input_format == "parquet":
             session.register_parquet(table_name, table_path, schema)
+        elif input_format == "lakehouse":
+            session.register_lakehouse(table_name, table_path, schema)
         else:
             raise ValueError(f"unsupported input format {input_format}")
         end = int(time.time() * 1000)

@@ -56,13 +56,10 @@ def transcode_table(
         elif output_mode == "append":
             # unique file names so new parts never clobber existing ones
             basename = f"part-{int(time.time() * 1000)}-{{i}}.{output_format}"
-    if output_format not in ("parquet", "csv"):
-        raise ValueError(f"unsupported output format {output_format}")
 
     arrow_schema = pa.schema(
         [(f.name, f.dtype.to_arrow(use_decimal)) for f in schema]
     )
-    part_col = TABLE_PARTITIONING.get(table) if partition else None
     rows = 0
 
     def batches():
@@ -70,6 +67,21 @@ def transcode_table(
         for b in iter_dat_batches(src, schema, use_decimal):
             rows += b.num_rows
             yield b
+
+    if output_format == "lakehouse":
+        # snapshot-manifest ACID table (Iceberg/Delta analogue) — the
+        # warehouse format the Data Maintenance phase mutates
+        from .lakehouse.table import LakehouseTable
+
+        if os.path.exists(dst) and LakehouseTable.is_table(dst):
+            LakehouseTable(dst).append(batches())  # output_mode == append
+        else:
+            LakehouseTable.create(dst, batches(), arrow_schema)
+        return rows
+    if output_format not in ("parquet", "csv"):
+        raise ValueError(f"unsupported output format {output_format}")
+
+    part_col = TABLE_PARTITIONING.get(table) if partition else None
 
     write_opts = {}
     if output_format == "parquet":

@@ -1,0 +1,191 @@
+"""Lakehouse + Data Maintenance tests (reference behavior:
+nds/nds_maintenance.py, nds/data_maintenance/*.sql, nds/nds_rollback.py)."""
+
+import csv
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from nds_tpu.engine.session import Session
+from nds_tpu.lakehouse.table import LakehouseTable
+from nds_tpu.maintenance import (
+    DM_FUNCS,
+    replace_date,
+    run_maintenance,
+)
+
+DATA = "/tmp/nds_test_sf001"
+REFRESH = "/tmp/nds_test_sf001_refresh"
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def data_dir():
+    if not os.path.exists(os.path.join(DATA, ".complete")):
+        subprocess.run(
+            [sys.executable, "-m", "nds_tpu.cli.gen_data", "--scale", "0.01",
+             "--parallel", "2", "--data_dir", DATA, "--overwrite_output"],
+            check=True, capture_output=True, cwd=REPO,
+        )
+        open(os.path.join(DATA, ".complete"), "w").close()
+    return DATA
+
+
+@pytest.fixture(scope="module")
+def refresh_dir():
+    if not os.path.exists(os.path.join(REFRESH, ".complete")):
+        subprocess.run(
+            [sys.executable, "-m", "nds_tpu.cli.gen_data", "--scale", "0.01",
+             "--parallel", "2", "--data_dir", REFRESH, "--update", "1",
+             "--overwrite_output"],
+            check=True, capture_output=True, cwd=REPO,
+        )
+        open(os.path.join(REFRESH, ".complete"), "w").close()
+    return REFRESH
+
+
+@pytest.fixture(scope="module")
+def warehouse(data_dir, tmp_path_factory):
+    """Transcode every source table to a lakehouse warehouse once."""
+    wh = tmp_path_factory.mktemp("lake")
+    subprocess.run(
+        [sys.executable, "-m", "nds_tpu.cli.transcode", data_dir, str(wh),
+         str(wh / "load.report"), "--output_format", "lakehouse"],
+        check=True, capture_output=True, cwd=REPO,
+        env={**os.environ, "NDS_PLATFORM": "cpu"},
+    )
+    return wh
+
+
+# ---- lakehouse table unit tests -----------------------------------------
+
+
+def test_lakehouse_snapshot_cycle(tmp_path):
+    t = pa.table({"a": np.arange(10, dtype=np.int64)})
+    path = str(tmp_path / "t")
+    lt = LakehouseTable.create(path, t)
+    assert lt.num_rows() == 10
+    v1_ts = lt.versions()[0][1]
+    lt.append(pa.table({"a": np.arange(5, dtype=np.int64)}))
+    assert lt.num_rows() == 15
+    lt.replace(pa.table({"a": np.arange(3, dtype=np.int64)}), operation="delete")
+    assert lt.num_rows() == 3
+    lt.rollback_to_timestamp(v1_ts)
+    assert lt.num_rows() == 10
+    assert lt.dataset().count_rows() == 10
+    ops = [op for _, _, op in lt.versions()]
+    assert ops == ["create", "append", "delete", "rollback-to-v1"]
+
+
+def test_dml_insert_delete_ctas_call(tmp_path):
+    d = str(tmp_path)
+    t = pa.table({"a": np.arange(10, dtype=np.int64)})
+    LakehouseTable.create(os.path.join(d, "t"), t)
+    s = Session(conf={"lakehouse.warehouse": d})
+    s.register_lakehouse("t", os.path.join(d, "t"))
+    # strftime truncates to seconds; wait first so before_ts > create time
+    time.sleep(1.1)
+    before_ts = time.strftime("%Y-%m-%d %H:%M:%S")
+    r = s.sql("insert into t (select a + 10 a from t)")
+    assert r.rows_affected == 10
+    assert s.sql("select count(*) c from t").to_pylist() == [{"c": 20}]
+    r = s.sql("delete from t where a >= 15")
+    assert r.rows_affected == 5
+    # survivors with NULL predicate stay (3VL)
+    s.sql("create table t3 location '" + os.path.join(d, "t3") + "' as " +
+          "select a, cast(null as int) n from t")
+    s.register_lakehouse("t3", os.path.join(d, "t3"))
+    r = s.sql("delete from t3 where n > 0")
+    assert r.rows_affected == 0
+    s.sql(f"call system.rollback_to_timestamp('t', timestamp '{before_ts}')")
+    assert s.sql("select count(*) c from t").to_pylist() == [{"c": 10}]
+
+
+def test_delete_all_rows_keeps_table_readable(tmp_path):
+    """An all-rows DELETE leaves zero data files; the manifest-carried schema
+    must keep the table readable (and truncate must work when empty)."""
+    d = str(tmp_path)
+    LakehouseTable.create(
+        os.path.join(d, "t"), pa.table({"a": np.arange(5, dtype=np.int64)})
+    )
+    s = Session(conf={"lakehouse.warehouse": d})
+    s.register_lakehouse("t", os.path.join(d, "t"))
+    r = s.sql("delete from t where a >= 0")
+    assert r.rows_affected == 5
+    assert s.sql("select count(*) c from t").to_pylist() == [{"c": 0}]
+    s.sql("delete from t")  # truncate on an already-empty table
+    assert s.sql("select count(*) c from t").to_pylist() == [{"c": 0}]
+    s.sql("insert into t (select 7 a)")
+    assert s.sql("select a from t").to_pylist() == [{"a": 7}]
+
+
+def test_replace_date_normalizes_order():
+    out = replace_date(
+        ["x DATE1 y DATE2"], [("2000-05-20", "2000-05-10")]
+    )
+    assert out == ["x 2000-05-10 y 2000-05-20"]
+
+
+# ---- full maintenance flow ----------------------------------------------
+
+
+def test_maintenance_lf_and_df(warehouse, refresh_dir, tmp_path):
+    ss = LakehouseTable(str(warehouse / "store_sales"))
+    inv = LakehouseTable(str(warehouse / "inventory"))
+    ss_before = ss.dataset().count_rows()
+    inv_before = inv.dataset().count_rows()
+    time_log = tmp_path / "dm.csv"
+    jdir = tmp_path / "json"
+    dm_time = run_maintenance(
+        warehouse_path=str(warehouse),
+        refresh_data_path=refresh_dir,
+        time_log_output_path=str(time_log),
+        json_summary_folder=str(jdir),
+        spec_queries=["LF_SS", "LF_I", "DF_SS", "DF_I"],
+    )
+    assert dm_time > 0
+    import json
+
+    statuses = {}
+    for f in os.listdir(jdir):
+        s = json.load(open(os.path.join(jdir, f)))
+        statuses[s["query"]] = s["queryStatus"]
+    assert statuses == {q: ["Completed"] for q in ("LF_SS", "LF_I", "DF_SS", "DF_I")}
+    # LF_SS inserted; DF_SS deleted a date range: history shows both
+    ops = [op for _, _, op in LakehouseTable(str(warehouse / "store_sales")).versions()]
+    assert "insert" in ops and "delete" in ops
+    rows = list(csv.reader(time_log.open()))
+    names = [r[1] for r in rows[1:]]
+    assert "Data Maintenance Time" in names
+    # refresh set at this scale inserts rows into store_sales
+    assert LakehouseTable(str(warehouse / "inventory")).versions()
+    # rollback restores pre-maintenance counts
+    ts = max(
+        LakehouseTable(str(warehouse / t)).versions()[0][1]
+        for t in ("store_sales", "inventory")
+    )
+    from nds_tpu.maintenance import rollback
+
+    import datetime
+
+    rollback(
+        str(warehouse),
+        datetime.datetime.fromtimestamp(ts / 1000 + 1).strftime(
+            "%Y-%m-%d %H:%M:%S"
+        ),
+        tables=["store_sales", "inventory"],
+    )
+    assert LakehouseTable(str(warehouse / "store_sales")).dataset().count_rows() == ss_before
+    assert LakehouseTable(str(warehouse / "inventory")).dataset().count_rows() == inv_before
+
+
+def test_all_dm_functions_have_sql():
+    from nds_tpu.maintenance import MAINTENANCE_SQL_DIR
+
+    for q in DM_FUNCS:
+        assert os.path.exists(os.path.join(MAINTENANCE_SQL_DIR, q + ".sql")), q
