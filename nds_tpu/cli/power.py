@@ -60,6 +60,12 @@ def main(argv=None):
         help="extra path to save a copy of the time log",
     )
     parser.add_argument(
+        "--mesh_devices",
+        type=int,
+        help="execute over an N-device jax mesh (fact tables row-sharded, "
+        "dims replicated); default is single-device",
+    )
+    parser.add_argument(
         "--sub_queries",
         type=lambda s: [x.strip() for x in s.split(",")],
         help="comma separated list of queries to run, e.g. 'query1,query2'. "
@@ -79,6 +85,7 @@ def main(argv=None):
         output_path=args.output_prefix,
         output_format=args.output_format,
         json_summary_folder=args.json_summary_folder,
+        mesh_devices=args.mesh_devices,
     )
 
 

@@ -115,6 +115,7 @@ def run_query_stream(
     output_format="parquet",
     json_summary_folder=None,
     keep_session=False,
+    mesh_devices=None,
 ):
     """Run the stream sequentially with per-query timing and reports.
 
@@ -132,7 +133,13 @@ def run_query_stream(
     if property_file:
         conf.update(load_properties(property_file))
     check_json_summary_folder(json_summary_folder)
-    session = Session(use_decimal=use_decimal, conf=conf)
+    mesh = None
+    if mesh_devices:
+        from .parallel.dist import make_mesh
+
+        mesh = make_mesh(mesh_devices)
+        conf["engine.mesh_devices"] = mesh_devices
+    session = Session(use_decimal=use_decimal, conf=conf, mesh=mesh)
     app_id = f"nds-tpu-{os.getpid()}-{int(total_time_start)}"
 
     execution_time_list = setup_tables(

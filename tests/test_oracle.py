@@ -1,0 +1,153 @@
+"""Independent-oracle differential tests: the engine vs sqlite3 over the same
+generated data.
+
+The reference validates CPU-Spark vs GPU-Spark (nds/nds_validate.py); beyond
+that two-backend differential (tests/test_dist_sql.py does mesh-vs-single
+chip), this file checks the engine against a wholly independent SQL
+implementation on a representative query battery."""
+
+import math
+import os
+import sqlite3
+import subprocess
+import sys
+
+import pytest
+
+from nds_tpu.engine.session import Session
+from nds_tpu.io.csv import read_dat_dir
+from nds_tpu.schema import get_schemas
+
+DATA = "/tmp/nds_test_sf001"
+TABLES = ("store_sales", "store_returns", "item", "date_dim", "store", "customer")
+
+
+@pytest.fixture(scope="module")
+def data_dir():
+    if not os.path.exists(os.path.join(DATA, ".complete")):
+        subprocess.run(
+            [sys.executable, "-m", "nds_tpu.cli.gen_data", "--scale", "0.01",
+             "--parallel", "2", "--data_dir", DATA, "--overwrite_output"],
+            check=True, capture_output=True,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        open(os.path.join(DATA, ".complete"), "w").close()
+    return DATA
+
+
+@pytest.fixture(scope="module")
+def engines(data_dir):
+    """(engine session, sqlite connection) over identical float-typed data."""
+    sess = Session(use_decimal=False)
+    conn = sqlite3.connect(":memory:")
+    for t in TABLES:
+        schema = get_schemas(use_decimal=False)[t]
+        path = os.path.join(data_dir, t)
+        sess.register_csv_dir(t, path, schema)
+        arrow = read_dat_dir(path, schema, use_decimal=False)
+        cols = ", ".join(f'"{f.name}"' for f in schema)
+        conn.execute(
+            f"create table {t} ({', '.join(f.name for f in schema)})"
+        )
+        import datetime
+
+        def plain(v):
+            return v.isoformat() if isinstance(v, datetime.date) else v
+
+        rows = [
+            tuple(plain(v) for v in row)
+            for row in zip(*(arrow.column(f.name).to_pylist() for f in schema))
+        ]
+        ph = ", ".join("?" for _ in schema)
+        conn.executemany(f"insert into {t} ({cols}) values ({ph})", rows)
+    return sess, conn
+
+
+# Queries valid in BOTH dialects (dates as ISO strings: sqlite compares them
+# lexicographically, the engine coerces string to date).
+QUERIES = [
+    # star join + group agg + order
+    """select d_year, i_brand_id, sum(ss_ext_sales_price) s
+       from date_dim, store_sales, item
+       where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+         and i_manager_id = 10 and d_moy = 11
+       group by d_year, i_brand_id
+       order by d_year, s desc, i_brand_id""",
+    # global aggregates
+    """select count(*) c, sum(ss_quantity) sq, avg(ss_ext_sales_price) av,
+              min(ss_sales_price) mn, max(ss_sales_price) mx
+       from store_sales""",
+    # IN subquery (semi)
+    """select count(*) c from store_sales
+       where ss_item_sk in (select i_item_sk from item where i_manager_id < 20)""",
+    # NOT IN (anti with 3VL on non-null key set)
+    """select count(*) c from store_sales
+       where ss_store_sk not in (select s_store_sk from store where s_state = 'TN')""",
+    # scalar subquery comparison
+    """select count(*) c from store_sales
+       where ss_ext_sales_price > (select avg(ss_ext_sales_price) from store_sales)""",
+    # left join + group + having + order
+    """select s_state, count(*) c from store_sales
+       left join store on ss_store_sk = s_store_sk
+       group by s_state having count(*) > 100 order by s_state""",
+    # distinct + order + limit
+    """select distinct ss_quantity from store_sales
+       where ss_quantity is not null order by ss_quantity limit 10""",
+    # correlated EXISTS
+    """select count(*) c from item i
+       where exists (select 1 from store_sales where ss_item_sk = i.i_item_sk
+                     and ss_quantity > 90)""",
+    # union all + outer aggregate
+    """select count(*) c from (
+         select ss_ticket_number x from store_sales
+         union all
+         select sr_ticket_number x from store_returns) t""",
+    # window function over partition
+    """select d_year, d_moy, rank() over (partition by d_year order by d_moy) r
+       from (select distinct d_year, d_moy from date_dim
+             where d_year = 2000 and d_moy <= 6) t
+       order by d_year, d_moy""",
+    # case + arithmetic
+    """select sum(case when ss_quantity > 50 then 1 else 0 end) hi,
+              sum(case when ss_quantity <= 50 then 1 else 0 end) lo
+       from store_sales""",
+    # date range on string-coerced dates
+    """select count(*) c from date_dim
+       where d_date between '1999-01-01' and '1999-12-31'""",
+]
+
+
+def _rows_close(a, b, eps=1e-6):
+    if len(a) != len(b):
+        return False
+    for ra, rb in zip(a, b):
+        if len(ra) != len(rb):
+            return False
+        for x, y in zip(ra, rb):
+            if x is None and y is None:
+                continue
+            if x is None or y is None:
+                return False
+            if isinstance(x, float) or isinstance(y, float):
+                fx, fy = float(x), float(y)
+                if math.isnan(fx) and math.isnan(fy):
+                    continue
+                if not math.isclose(fx, fy, rel_tol=1e-6, abs_tol=1e-9):
+                    return False
+            elif x != y:
+                return False
+    return True
+
+
+@pytest.mark.parametrize("qi", range(len(QUERIES)))
+def test_engine_matches_sqlite(engines, qi):
+    sess, conn = engines
+    q = QUERIES[qi]
+    ours = [list(r.values()) for r in sess.sql(q).to_pylist()]
+    oracle = [list(r) for r in conn.execute(q).fetchall()]
+    if "order by" not in q.lower():
+        ours.sort(key=str)
+        oracle.sort(key=str)
+    assert _rows_close(ours, oracle), (
+        f"query {qi} mismatch:\nengine: {ours[:5]}\nsqlite: {oracle[:5]}"
+    )

@@ -1,0 +1,96 @@
+"""Validator semantics tests (reference behavior: nds/nds_validate.py)."""
+
+import json
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from nds_tpu.validate import (
+    compare,
+    compare_results,
+    iterate_queries,
+    row_equal,
+    update_summary,
+)
+
+
+def test_compare_scalar_semantics():
+    assert compare(1.0, 1.0 + 1e-9)
+    assert not compare(1.0, 1.1)
+    assert compare(float("nan"), float("nan"))
+    assert compare(None, None)
+    assert not compare(None, 1.0)
+    assert not compare(1.0, None)
+    assert compare("a", "a")
+    assert not compare("a", "b")
+    from decimal import Decimal
+
+    assert compare(Decimal("10.00"), Decimal("10.0000001"))
+    assert compare(Decimal("10.00"), 10.0)  # cross-engine numeric
+
+
+def test_q78_fourth_column_tolerance():
+    r1 = [1, "a", 2, 0.50, 9.0]
+    r2 = [1, "a", 2, 0.505, 9.0]
+    assert row_equal(r1, r2, 1e-5, is_q78=True)
+    r3 = [1, "a", 2, 0.52, 9.0]
+    assert not row_equal(r1, r3, 1e-5, is_q78=True)
+    assert row_equal([1, 2, 3, None], [1, 2, 3, None], 1e-5, is_q78=True)
+    assert not row_equal([1, 2, 3, None], [1, 2, 3, 0.5], 1e-5, is_q78=True)
+
+
+def _write(path, table):
+    os.makedirs(path, exist_ok=True)
+    pq.write_table(table, os.path.join(path, "part-0.parquet"))
+
+
+def test_compare_results_ordering(tmp_path):
+    t1 = pa.table({"k": [1, 2, 3], "v": [1.0, 2.0, 3.0]})
+    t2 = pa.table({"k": [3, 1, 2], "v": [3.0, 1.0, 2.0]})
+    _write(tmp_path / "a", t1)
+    _write(tmp_path / "b", t2)
+    assert not compare_results(str(tmp_path / "a"), str(tmp_path / "b"))
+    assert compare_results(
+        str(tmp_path / "a"), str(tmp_path / "b"), ignore_ordering=True
+    )
+
+
+def test_compare_results_count_mismatch(tmp_path):
+    _write(tmp_path / "a", pa.table({"k": [1, 2]}))
+    _write(tmp_path / "b", pa.table({"k": [1]}))
+    assert not compare_results(str(tmp_path / "a"), str(tmp_path / "b"))
+
+
+def test_iterate_and_update_summary(tmp_path):
+    ok = pa.table({"k": [1], "v": [1.0]})
+    bad = pa.table({"k": [1], "v": [9.0]})
+    for q, (l, r) in {
+        "query1": (ok, ok),
+        "query2": (ok, bad),
+        "query65": (ok, bad),  # always skipped
+    }.items():
+        _write(tmp_path / "run1" / q, l)
+        _write(tmp_path / "run2" / q, r)
+    queries = ["query1", "query2", "query65"]
+    unmatch = iterate_queries(
+        str(tmp_path / "run1"), str(tmp_path / "run2"), queries
+    )
+    assert unmatch == ["query2"]
+    jdir = tmp_path / "json"
+    os.makedirs(jdir)
+    for q, status in [("query1", "Completed"), ("query2", "Completed"), ("query65", "Failed")]:
+        with open(jdir / f"-{q}-123.json", "w") as f:
+            json.dump({"query": q, "queryStatus": [status]}, f)
+    update_summary(str(jdir), unmatch + ["query65"], queries)
+    got = {}
+    for f in os.listdir(jdir):
+        s = json.load(open(jdir / f))
+        got[s["query"]] = s["queryValidationStatus"]
+    assert got == {
+        "query1": ["Pass"],
+        "query2": ["Fail"],
+        "query65": ["NotAttempted"],
+    }
