@@ -1,0 +1,44 @@
+select *
+from (select avg(ss_list_price) b1_lp, count(ss_list_price) b1_cnt,
+             count(distinct ss_list_price) b1_cntd
+      from store_sales
+      where ss_quantity between 0 and 5
+        and (ss_list_price between [LP1] and [LP1] + 10
+             or ss_coupon_amt between [CA1] and [CA1] + 1000
+             or ss_wholesale_cost between [WC1] and [WC1] + 20)) b1,
+     (select avg(ss_list_price) b2_lp, count(ss_list_price) b2_cnt,
+             count(distinct ss_list_price) b2_cntd
+      from store_sales
+      where ss_quantity between 6 and 10
+        and (ss_list_price between [LP2] and [LP2] + 10
+             or ss_coupon_amt between [CA2] and [CA2] + 1000
+             or ss_wholesale_cost between [WC2] and [WC2] + 20)) b2,
+     (select avg(ss_list_price) b3_lp, count(ss_list_price) b3_cnt,
+             count(distinct ss_list_price) b3_cntd
+      from store_sales
+      where ss_quantity between 11 and 15
+        and (ss_list_price between [LP3] and [LP3] + 10
+             or ss_coupon_amt between [CA3] and [CA3] + 1000
+             or ss_wholesale_cost between [WC3] and [WC3] + 20)) b3,
+     (select avg(ss_list_price) b4_lp, count(ss_list_price) b4_cnt,
+             count(distinct ss_list_price) b4_cntd
+      from store_sales
+      where ss_quantity between 16 and 20
+        and (ss_list_price between [LP4] and [LP4] + 10
+             or ss_coupon_amt between [CA4] and [CA4] + 1000
+             or ss_wholesale_cost between [WC4] and [WC4] + 20)) b4,
+     (select avg(ss_list_price) b5_lp, count(ss_list_price) b5_cnt,
+             count(distinct ss_list_price) b5_cntd
+      from store_sales
+      where ss_quantity between 21 and 25
+        and (ss_list_price between [LP5] and [LP5] + 10
+             or ss_coupon_amt between [CA5] and [CA5] + 1000
+             or ss_wholesale_cost between [WC5] and [WC5] + 20)) b5,
+     (select avg(ss_list_price) b6_lp, count(ss_list_price) b6_cnt,
+             count(distinct ss_list_price) b6_cntd
+      from store_sales
+      where ss_quantity between 26 and 30
+        and (ss_list_price between [LP6] and [LP6] + 10
+             or ss_coupon_amt between [CA6] and [CA6] + 1000
+             or ss_wholesale_cost between [WC6] and [WC6] + 20)) b6
+limit 100

@@ -1,0 +1,26 @@
+select *
+from (select i_manager_id,
+             sum(ss_sales_price) sum_sales,
+             avg(sum(ss_sales_price))
+               over (partition by i_manager_id) avg_monthly_sales
+      from item, store_sales, date_dim, store
+      where ss_item_sk = i_item_sk
+        and ss_sold_date_sk = d_date_sk
+        and ss_store_sk = s_store_sk
+        and d_month_seq in ([DMS], [DMS] + 1, [DMS] + 2, [DMS] + 3,
+                            [DMS] + 4, [DMS] + 5, [DMS] + 6, [DMS] + 7,
+                            [DMS] + 8, [DMS] + 9, [DMS] + 10, [DMS] + 11)
+        and ((i_category in ('[CAT_A1]', '[CAT_A2]', '[CAT_A3]')
+              and i_class in ('[CLASS_A1]', '[CLASS_A2]', '[CLASS_A3]', '[CLASS_A4]')
+              and i_brand in ('[BRAND_A1]', '[BRAND_A2]',
+                              '[BRAND_A3]', '[BRAND_A4]'))
+          or (i_category in ('[CAT_B1]', '[CAT_B2]', '[CAT_B3]')
+              and i_class in ('[CLASS_B1]', '[CLASS_B2]', '[CLASS_B3]', '[CLASS_B4]')
+              and i_brand in ('[BRAND_B1]', '[BRAND_B2]',
+                              '[BRAND_B3]', '[BRAND_B4]')))
+      group by i_manager_id, d_moy) tmp1
+where case when avg_monthly_sales > 0
+           then abs(sum_sales - avg_monthly_sales) / avg_monthly_sales
+           else null end > 0.1
+order by i_manager_id, avg_monthly_sales, sum_sales
+limit 100

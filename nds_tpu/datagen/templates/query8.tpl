@@ -1,0 +1,22 @@
+select s_store_name, sum(ss_net_profit)
+from store_sales, date_dim, store,
+     (select ca_zip
+      from (
+        (select substr(ca_zip, 1, 5) ca_zip
+         from customer_address
+         where substr(ca_zip, 1, 5) in ([ZIPLIST]))
+        intersect
+        (select ca_zip
+         from (select substr(ca_zip, 1, 5) ca_zip, count(*) cnt
+               from customer_address, customer
+               where ca_address_sk = c_current_addr_sk
+                 and c_preferred_cust_flag = 'Y'
+               group by ca_zip
+               having count(*) > 10) a1)) a2) v1
+where ss_store_sk = s_store_sk
+  and ss_sold_date_sk = d_date_sk
+  and d_qoy = [QOY] and d_year = [YEAR]
+  and (substr(s_zip, 1, 2) = substr(v1.ca_zip, 1, 2))
+group by s_store_name
+order by s_store_name
+limit 100

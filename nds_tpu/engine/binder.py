@@ -71,6 +71,7 @@ class Binder:
         self.catalog = catalog  # object with .schema(name) -> Schema | None
         self._counter = 0
         self._cte_plans = {}  # name -> (plan, columns) registered per bind
+        self._subquery_residual = None  # set by _CorrelatedBinder.run
 
     def fresh(self, prefix="_c"):
         self._counter += 1
@@ -213,7 +214,31 @@ class Binder:
             ):
                 e = scope.aliases[e.name]
             else:
-                e = self._bind_expr(e, scope, views)
+                try:
+                    e = self._bind_expr(e, scope, views)
+                except BindError:
+                    # select aliases are visible inside ORDER BY expressions
+                    # (q36/q70/q86: `case when lochierarchy = 0 then ...`).
+                    # Alias exprs are already bound: shield them behind
+                    # placeholders while the rest of the expression binds.
+                    placeholders = {}
+
+                    def sub_alias(x):
+                        if (
+                            isinstance(x, E.Col)
+                            and x.table is None
+                            and x.name in scope.aliases
+                        ):
+                            ph = E.Col(self.fresh("_ob"))
+                            placeholders[ph.name] = scope.aliases[x.name]
+                            return ph
+                        return _rewrite_children(x, sub_alias)
+
+                    e = self._bind_expr_partial(
+                        sub_alias(e), scope, views, skip=set(placeholders)
+                    )
+                    for name, repl in placeholders.items():
+                        e = _replace_node(e, E.Col(name), repl)
             order_exprs.append((e, it.ascending, it.nulls_first))
 
         group_exprs = []
@@ -506,21 +531,37 @@ class Binder:
         """Returns fn(base_plan) -> new_plan implementing the predicate."""
         if sub.kind == "exists":
             inner_plan, joins = self._bind_correlated(sub.query, scope, views)
+            resid = self._subquery_residual
+            if resid is not None and not joins:
+                raise BindError(
+                    "correlated non-equi subquery predicate needs at least "
+                    "one equi correlation to join on"
+                )
             kind = "anti" if _under_not(conj, sub) else "semi"
             lkeys = [o for o, _ in joins]
             rkeys = [i for _, i in joins]
-            return lambda base: P.Join(kind, base, inner_plan, lkeys, rkeys)
+            return lambda base: P.Join(
+                kind, base, inner_plan, lkeys, rkeys, resid
+            )
         if sub.kind == "in":
             operand = self._bind_expr(sub.operand, scope, views)
             inner_plan, joins = self._bind_correlated(
                 sub.query, scope, views
             )
+            resid = self._subquery_residual
             sub_cols = self._subquery_out_cols
             negated = sub.negated or _under_not(conj, sub)
             if not negated:
                 lkeys = [operand] + [o for o, _ in joins]
                 rkeys = [E.Col(sub_cols[0][0])] + [i for _, i in joins]
-                return lambda base: P.Join("semi", base, inner_plan, lkeys, rkeys)
+                return lambda base: P.Join(
+                    "semi", base, inner_plan, lkeys, rkeys, resid
+                )
+            if resid is not None:
+                raise BindError(
+                    "correlated non-equi predicate under NOT IN is not "
+                    "supported"
+                )
             mark_specs, pred = self._not_in_lowering(
                 operand, inner_plan, joins, sub_cols
             )
@@ -536,6 +577,13 @@ class Binder:
             # placeholder for the subquery value so an outer column sharing
             # the subquery's output alias can't collide during binding.
             inner_plan, joins = self._bind_correlated(sub.query, scope, views)
+            if self._subquery_residual is not None:
+                # the left-join decorrelation below has nowhere to evaluate a
+                # non-equi correlated predicate; refuse rather than drop it
+                raise BindError(
+                    "correlated non-equi predicate in a scalar subquery is "
+                    "not supported"
+                )
             sub_cols = self._subquery_out_cols
             placeholder = E.Col(self.fresh("_sqv"))
             cmp = _replace_node(conj, sub, placeholder)
@@ -619,7 +667,7 @@ class Binder:
         for sub in subs:
             if sub.kind == "scalar":
                 inner_plan, joins = self._bind_correlated(sub.query, scope, views)
-                if joins:
+                if joins or self._subquery_residual is not None:
                     raise BindError(
                         "correlated scalar subquery under OR is not supported"
                     )
@@ -642,7 +690,7 @@ class Binder:
                 )
                 for plan, lk, rk, name in specs:
                     marks.add(name)
-                    mark_joins.append((plan, lk, rk, name))
+                    mark_joins.append((plan, lk, rk, name, None))
                 # repl is fully bound already; protect it from re-binding
                 placeholder = E.Col(self.fresh("_nip"))
                 marked_replacements[placeholder.name] = repl
@@ -659,15 +707,18 @@ class Binder:
                 rkeys = [E.Col(sub_cols[0][0])] + rkeys
             repl = E.Col(mark)
             rewritten = _replace_node(rewritten, sub, repl)
-            mark_joins.append((inner_plan, lkeys, rkeys, mark))
+            mark_joins.append(
+                (inner_plan, lkeys, rkeys, mark, self._subquery_residual)
+            )
         pred = self._bind_expr_partial(rewritten, scope, views, skip=marks)
         for name, repl in marked_replacements.items():
             pred = _replace_node(pred, E.Col(name), repl)
 
         def apply(base):
-            for inner_plan, lkeys, rkeys, mark in mark_joins:
+            for inner_plan, lkeys, rkeys, mark, resid in mark_joins:
                 base = P.Join(
-                    "mark", base, inner_plan, lkeys, rkeys, mark_name=mark
+                    "mark", base, inner_plan, lkeys, rkeys, resid,
+                    mark_name=mark,
                 )
             return P.Filter(pred, base)
 
@@ -736,6 +787,7 @@ class _CorrelatedBinder:
         inner_probe, _ = _probe_scope(self.binder, q, self.outer, self.views)
         kept = []
         corr_inner_exprs = []
+        residual_conjs = []  # correlated NON-equi conjuncts (q16/q94 `<>`)
         if q.where is not None:
             for conj in _conjuncts(q.where):
                 pair = self._try_correlated_equi(conj, inner_probe)
@@ -743,35 +795,101 @@ class _CorrelatedBinder:
                     outer_e, inner_e = pair
                     self.corr.append((outer_e, inner_e))
                     corr_inner_exprs.append(inner_e)
+                elif self._refs_outer(conj, inner_probe):
+                    residual_conjs.append(conj)
                 else:
                     kept.append(conj)
             q.where = _conjoin_ast(kept)
-        if self.corr and _is_scalar_agg(q):
+        # binder._subquery_residual is set fresh on every return path below:
+        # nested subqueries bound inside bind_select re-enter this method and
+        # would otherwise leak their residual onto the enclosing join
+        if (self.corr or residual_conjs) and _is_scalar_agg(q):
+            if residual_conjs:
+                raise BindError(
+                    "correlated non-equi predicate in a scalar subquery is "
+                    "not supported"
+                )
             # group the aggregate by the correlation keys
             q = dataclasses.replace(q, group_by=list(q.group_by))
             plan, cols = self._bind_grouped_scalar(q, corr_inner_exprs)
+            self.binder._subquery_residual = None
             return plan, cols
-        if self.corr:
-            # expose the inner correlation keys through the subquery's own
-            # projection (binding them in the subquery scope, where they
-            # resolve correctly)
+        if self.corr or residual_conjs:
+            # expose the inner correlation keys (and any inner columns the
+            # non-equi residual needs) through the subquery's own projection
+            # (binding them in the subquery scope, where they resolve
+            # correctly). The residual itself becomes a join residual on the
+            # semi/anti/mark join, evaluated over the pair table where both
+            # sides' columns coexist.
             binder = self.binder
-            key_aliases = [binder.fresh("_ck") for _ in corr_inner_exprs]
+            res_inner = []  # raw (name, table) inner Col refs of the residual
+            for conj in residual_conjs:
+                for x in E.walk(conj):
+                    if isinstance(x, E.Col) and self._is_inner(x, inner_probe):
+                        key = (x.name, x.table)
+                        if key not in [(c.name, c.table) for c in res_inner]:
+                            res_inner.append(x)
+            extra = list(corr_inner_exprs) + list(res_inner)
+            key_aliases = [binder.fresh("_ck") for _ in extra]
             q = dataclasses.replace(
                 q,
                 select_items=list(q.select_items)
-                + [(e, a) for e, a in zip(corr_inner_exprs, key_aliases)],
+                + [(e, a) for e, a in zip(extra, key_aliases)],
             )
             plan, cols = binder.bind_select(q, self.outer, self.views)
-            nk = len(corr_inner_exprs)
+            nk = len(extra)
             val_cols, key_cols = cols[:-nk], cols[-nk:]
+            ncorr = len(corr_inner_exprs)
             self.corr[:] = [
-                (o, E.Col(kc[0])) for (o, _), kc in zip(self.corr, key_cols)
+                (o, E.Col(kc[0]))
+                for (o, _), kc in zip(self.corr, key_cols[:ncorr])
             ]
+            bound_residual = None
+            if residual_conjs:
+                # bind each residual conjunct: inner cols -> their exposed
+                # output columns; everything else -> the outer scope
+                inner_map = {
+                    (c.name, c.table): E.Col(kc[0])
+                    for c, kc in zip(res_inner, key_cols[ncorr:])
+                }
+
+                def bind_residual(x):
+                    if isinstance(x, E.Col):
+                        if (x.name, x.table) in inner_map and self._is_inner(
+                            x, inner_probe
+                        ):
+                            return inner_map[(x.name, x.table)]
+                        qn, _ = self.outer.resolve(x.name, x.table)
+                        return E.Col(qn)
+                    return _rewrite_children(x, bind_residual)
+
+                bound_residual = _conjoin(
+                    [bind_residual(c) for c in residual_conjs]
+                )
+            binder._subquery_residual = bound_residual
             binder._subquery_out_cols = val_cols
             return plan, val_cols
         plan, cols = self.binder.bind_select(q, self.outer, self.views)
+        self.binder._subquery_residual = None
         return plan, cols
+
+    def _is_inner(self, col: E.Col, inner_probe) -> bool:
+        try:
+            inner_probe.resolve(col.name, col.table)
+            return True
+        except BindError:
+            return False
+
+    def _refs_outer(self, conj, inner_probe) -> bool:
+        """True if the conjunct references at least one outer column."""
+        for x in E.walk(conj):
+            if isinstance(x, E.Col) and not self._is_inner(x, inner_probe):
+                try:
+                    self.outer.resolve(x.name, x.table)
+                    return True
+                except BindError:
+                    pass
+        return False
 
     def _bind_grouped_scalar(self, q, corr_inner_exprs):
         binder = self.binder

@@ -59,6 +59,26 @@ def _pad_to(arr: jnp.ndarray, cap: int, fill=0) -> jnp.ndarray:
 
 
 @dataclass(frozen=True)
+class ColStats:
+    """Host-side column statistics captured once at catalog load.
+
+    Bounds are over the column's *base-table* non-null values, so they stay
+    conservatively valid through any row subset (filter/compact/sort) and any
+    gather (join output). `unique` means the base column's non-null values
+    are pairwise distinct — preserved by subsetting, destroyed by joins.
+    The executor's fast-path plan choices (dense star-join, direct
+    aggregation) read these instead of issuing device round-trips, so picking
+    a physical strategy costs zero host syncs on the query hot path (the
+    round-2 regression: per-join masked_min_max + counts.max() syncs).
+    """
+
+    vmin: int
+    vmax: int
+    unique: bool
+    base_rows: int  # live rows of the base table the bounds came from
+
+
+@dataclass(frozen=True)
 class Column:
     """One column: device buffer + optional validity + optional dictionary.
 
@@ -71,6 +91,7 @@ class Column:
     dtype: DType
     valid: Optional[jnp.ndarray] = None  # bool; None == all valid
     dictionary: Optional[pa.Array] = None  # for string dtypes: distinct values
+    stats: Optional[ColStats] = None  # base-table stats (see ColStats)
 
     @property
     def is_string(self) -> bool:
@@ -78,6 +99,17 @@ class Column:
 
     def with_valid(self, valid: Optional[jnp.ndarray]) -> "Column":
         return replace(self, valid=valid)
+
+    def subset_stats(self) -> Optional[ColStats]:
+        """Stats valid for any row-subset/permutation of this column."""
+        return self.stats
+
+    def gather_stats(self) -> Optional[ColStats]:
+        """Stats valid after a gather with possible repeats (join output):
+        bounds survive, uniqueness does not."""
+        if self.stats is None:
+            return None
+        return replace(self.stats, unique=False)
 
 
 @dataclass
@@ -175,11 +207,47 @@ def column_from_arrow(arr: pa.ChunkedArray | pa.Array, dtype: DType, cap: int) -
     return Column(data, dtype, valid, dictionary)
 
 
-def table_from_arrow(batch: pa.Table | pa.RecordBatch, schema=None) -> Table:
+# Above this many rows, per-column uniqueness (count_distinct) is skipped at
+# load: only dimension-sized build sides benefit, and larger tables are
+# rejected by the dense-join domain cap anyway.
+_UNIQUE_STATS_MAX_ROWS = 1 << 22
+
+
+def arrow_column_stats(arr, dtype: DType, nrows: int) -> Optional[ColStats]:
+    """Host-side min/max/uniqueness of an integer-like Arrow column.
+
+    One vectorized Arrow pass per column at catalog-load time buys sync-free
+    physical plan choice for every query that later touches the column."""
+    if dtype.kind not in ("int32", "int64", "date"):
+        return None
+    if nrows == 0:
+        return None
+    if isinstance(arr, pa.ChunkedArray) and arr.num_chunks == 0:
+        return None
+    if dtype.kind == "date":
+        # date32 scalars don't cast to int; min/max over the day numbers
+        arr = arr.cast(pa.int32())
+    mm = pc.min_max(arr)
+    vmin, vmax = mm["min"], mm["max"]
+    if not vmin.is_valid:  # all-null column
+        return None
+    vmin = vmin.cast(pa.int64()).as_py()
+    vmax = vmax.cast(pa.int64()).as_py()
+    unique = False
+    if nrows <= _UNIQUE_STATS_MAX_ROWS:
+        n_valid = nrows - arr.null_count
+        unique = pc.count_distinct(arr, mode="only_valid").as_py() == n_valid
+    return ColStats(vmin, vmax, unique, nrows)
+
+
+def table_from_arrow(
+    batch: pa.Table | pa.RecordBatch, schema=None, with_stats: bool = False
+) -> Table:
     """Build a device Table from an Arrow table.
 
     `schema` (nds_tpu.schema.Schema) supplies logical types; if omitted they
-    are inferred from the Arrow types.
+    are inferred from the Arrow types. `with_stats` captures per-column
+    ColStats (catalog loads set it; ad-hoc intermediates skip the pass).
     """
     nrows = batch.num_rows
     cap = bucket_cap(nrows)
@@ -191,7 +259,12 @@ def table_from_arrow(batch: pa.Table | pa.RecordBatch, schema=None) -> Table:
             dtype = schema.field(name).dtype
         else:
             dtype = _infer_dtype(batch.schema.field(i).type)
-        cols[name] = column_from_arrow(batch.column(i), dtype, cap)
+        col = column_from_arrow(batch.column(i), dtype, cap)
+        if with_stats and col.stats is None:
+            stats = arrow_column_stats(batch.column(i), dtype, nrows)
+            if stats is not None:
+                col = replace(col, stats=stats)
+        cols[name] = col
     return Table(cols, nrows)
 
 
@@ -284,8 +357,9 @@ def sort_dictionary(col: Column):
     rank codes is comparing strings.
     """
     d = col.dictionary
-    if d is None:
-        return col.data, None
+    if d is None or len(d) == 0:
+        # all-null string column (e.g. c_login): nothing to rank
+        return col.data, d
     d = d.cast(pa.string())
     order = pc.array_sort_indices(d)  # indices of values in sorted order
     rank = np.empty(len(d), dtype=np.int32)

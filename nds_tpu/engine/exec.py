@@ -100,6 +100,7 @@ class Executor:
                 c.dtype,
                 None if c.valid is None else c.valid[:cap],
                 c.dictionary,
+                c.subset_stats(),
             )
             for name, c in child.columns.items()
         }
@@ -273,7 +274,8 @@ class Executor:
         llive = left.row_mask()
         rlive = right.row_mask()
         fast = self._try_dense_join(
-            left, right, kind, lk, lv, rk, rv, llive, rlive, residual, mark_name
+            left, right, kind, lcols, rcols, lk, lv, rk, rv, llive, rlive,
+            residual, mark_name,
         )
         if fast is not None:
             return fast
@@ -364,10 +366,14 @@ class Executor:
     # domain is dense (surrogate keys). Probes are elementwise gathers, so
     # the fact side never sorts, and under a mesh the probe stays local per
     # chip (build side replicated). Falls back to the sort join otherwise.
+    # Plan choice is driven purely by catalog-load ColStats — zero device
+    # round-trips here (the round-2 per-join masked_min_max/counts.max()
+    # syncs were the 2x single-chip regression).
     _DENSE_MAX_DOMAIN = 1 << 22
 
     def _try_dense_join(
-        self, left, right, kind, lk, lv, rk, rv, llive, rlive, residual, mark_name
+        self, left, right, kind, lcols, rcols, lk, lv, rk, rv, llive, rlive,
+        residual, mark_name,
     ):
         if len(lk) != 1:
             return None
@@ -377,20 +383,31 @@ class Executor:
             return None
         if kind == "left" and residual is not None:
             return None
+        # int-like keys on both sides only: stats exist for these alone, and
+        # the gate keeps float/decimal keys (value-changing casts) off the
+        # dense path entirely
+        for c in (lcols[0], rcols[0]):
+            if c.dtype.kind not in ("int32", "int64", "date"):
+                return None
+        rst = rcols[0].stats
+        if rst is None:
+            return None
+        if kind in ("inner", "left") and not rst.unique:
+            # inner/left must not expand output per probe row; without a
+            # uniqueness guarantee from base-table stats, use the sort join
+            return None
+        rmin, rmax = rst.vmin, rst.vmax
+        domain = rmax - rmin + 1
+        # bound the lookup table by the BASE table's size (bounds are base-
+        # table-wide even when the build side is already filtered down)
+        if domain > min(
+            self._DENSE_MAX_DOMAIN, max(1 << 14, 8 * max(rst.base_rows, right.cap))
+        ):
+            return None
         rnn = K._all_valid([rv[0]], rlive)
         rkey = rk[0].astype(jnp.int64)
-        rmin, rmax = K.masked_min_max(rkey, rnn)
-        if rmin > rmax:
-            return None  # no joinable build rows; sort path handles empties
-        domain = rmax - rmin + 1
-        if domain > min(self._DENSE_MAX_DOMAIN, max(1 << 14, 8 * right.cap)):
-            return None
         table_cap = bucket_cap(domain)
-        presence, rows, counts = K.dense_build(rkey, rnn, rmin, table_cap)
-        # inner/left require a unique build side (no output expansion);
-        # check before probing so the fallback never pays a wasted probe
-        if kind in ("inner", "left") and int(counts.max()) > 1:
-            return None
+        presence, rows = K.dense_build(rkey, rnn, rmin, table_cap)
         lnn = K._all_valid([lv[0]], llive)
         matched, ri = K.dense_probe(
             lk[0].astype(jnp.int64), lnn, rmin, presence, rows, table_cap
@@ -415,7 +432,8 @@ class Executor:
         for name, c in right.columns.items():
             valid = c.valid[ri_safe] if c.valid is not None else jnp.ones(left.cap, bool)
             out_cols[name] = Column(
-                c.data[ri_safe], c.dtype, valid & matched, c.dictionary
+                c.data[ri_safe], c.dtype, valid & matched, c.dictionary,
+                c.gather_stats(),
             )
         return Table(out_cols, left.nrows)
 
@@ -479,6 +497,7 @@ class Executor:
         )
 
     def _pair_table(self, left, right, li, ri, nrows, rnull, lnull=None):
+        # join-output gather can repeat rows: bounds survive, uniqueness dies
         cols = {}
         for name, c in left.columns.items():
             data = c.data[li]
@@ -486,14 +505,16 @@ class Executor:
             if lnull is not None:
                 v = valid if valid is not None else jnp.ones(li.shape[0], bool)
                 valid = v & ~lnull
-            cols[name] = Column(data, c.dtype, valid, c.dictionary)
+            cols[name] = Column(data, c.dtype, valid, c.dictionary,
+                                c.gather_stats())
         for name, c in right.columns.items():
             data = c.data[ri]
             valid = None if c.valid is None else c.valid[ri]
             if rnull is not None:
                 v = valid if valid is not None else jnp.ones(ri.shape[0], bool)
                 valid = v & ~rnull
-            cols[name] = Column(data, c.dtype, valid, c.dictionary)
+            cols[name] = Column(data, c.dtype, valid, c.dictionary,
+                                c.gather_stats())
         return Table(cols, nrows)
 
     def _cross_join(self, left, right):
@@ -587,15 +608,24 @@ class Executor:
         datas, valids, mins, ranges = [], [], [], []
         domain = 1
         for _, c in active:
-            if c.dtype.kind in ("float64", "float32"):
+            # key bounds come from catalog ColStats (or are statically known
+            # for dictionary codes / bools) — never from a device round-trip;
+            # keys without bounds fall back to the sort-based aggregation
+            if c.dtype.is_string:
+                if c.dictionary is None or len(c.dictionary) == 0:
+                    return None
+                kmin, kmax = 0, len(c.dictionary) - 1
+            elif c.dtype.kind == "bool":
+                kmin, kmax = 0, 1
+            elif c.dtype.kind in ("int32", "int64", "date"):
+                if c.stats is None:
+                    return None
+                kmin, kmax = c.stats.vmin, c.stats.vmax
+            else:
                 return None
             data = c.data
             if data.dtype == jnp.bool_:
                 data = data.astype(jnp.int32)
-            nn = live & c.valid if c.valid is not None else live
-            kmin, kmax = K.masked_min_max(data.astype(jnp.int64), nn)
-            if kmin > kmax:
-                return None
             krange = kmax - kmin + 1 + (1 if c.valid is not None else 0)
             domain *= krange
             if domain > self._DIRECT_AGG_MAX_DOMAIN:
@@ -915,6 +945,11 @@ class Executor:
             dtype = INT64
         else:
             c = ev.eval(wf.arg)
+            if c.dtype.is_string and fn in ("min", "max"):
+                # rank-transform codes so min/max compares lexicographically
+                # (raw dictionary codes are in encounter order)
+                ranks, sorted_dict = sort_dictionary(c)
+                c = Column(ranks, c.dtype, c.valid, sorted_dict)
             sdata = c.data[order]
             w = live[order]
             if c.valid is not None:
@@ -938,7 +973,54 @@ class Executor:
             )
 
         if fn in ("min", "max"):
-            raise ExecError(f"window {fn} over a moving frame not supported")
+            # running min/max (q51: `rows unbounded preceding..current row`)
+            # via a segmented scan: flag-carrying associative operator resets
+            # at partition starts, so one lax.associative_scan covers all
+            # partitions without a loop
+            if frame not in (
+                (("unbounded", "preceding"), ("current", None)),
+                None,
+            ):
+                raise ExecError(f"window {fn} over frame {frame}")
+            ext = K._extreme(sdata.dtype, is_max=(fn == "min"))
+            x = jnp.where(w, sdata, ext)
+            n = x.shape[0]
+            starts = jnp.zeros(n, bool).at[0].set(True)
+            starts = starts.at[1:].max(gid[1:] != gid[:-1])
+            combine = jnp.minimum if fn == "min" else jnp.maximum
+
+            def op(a, b):
+                fa, va = a
+                fb, vb = b
+                return fa | fb, jnp.where(fb, vb, combine(va, vb))
+
+            _, scanned = jax.lax.associative_scan(op, (starts, x))
+            cnt_run = _segment_cumsum(w.astype(jnp.int64), gid)
+            if frame is None:
+                # RANGE default: current row's peers (equal order keys) are
+                # in-frame, so read the running value at the peer-group end
+                sorted_keys = [d[order] for d, _, _, _ in okeys]
+                sorted_valids = [
+                    None if v is None else v[order] for _, v, _, _ in okeys
+                ]
+                oflags = K._group_flags(
+                    [gid] + sorted_keys, [None] + sorted_valids, live[order]
+                )
+                ogid = jnp.cumsum(oflags.astype(jnp.int32)) - 1
+                n_og = int(ogid[child.nrows - 1]) + 1 if child.nrows else 1
+                ogcap = bucket_cap(max(n_og, 1))
+                og_first = K.segment_starts(ogid, ogcap)
+                og_count = K.segment_reduce(
+                    jnp.ones_like(ogid, jnp.int64), ogid,
+                    jnp.ones(ogid.shape, bool), ogcap, "count",
+                )
+                og_end = (og_first.astype(jnp.int64) + og_count - 1)[ogid]
+                og_end = jnp.clip(og_end, 0, child.cap - 1).astype(jnp.int32)
+                scanned = scanned[og_end]
+                cnt_run = cnt_run[og_end]
+            return self._window_result(
+                fn, scanned[inv], cnt_run[inv], c, dtype
+            )
 
         x = jnp.where(w, sdata, jnp.zeros((), sdata.dtype))
         if jnp.issubdtype(x.dtype, jnp.integer):
@@ -1100,6 +1182,8 @@ class Executor:
         return self._take(table, idx, count)
 
     def _take(self, table: Table, idx, nrows) -> Table:
+        # idx is a permutation or de-duplicated subset of live rows
+        # (sort order / compact indices), so base-table stats stay valid
         cols = {}
         for name, c in table.columns.items():
             cols[name] = Column(
@@ -1107,6 +1191,7 @@ class Executor:
                 c.dtype,
                 None if c.valid is None else c.valid[idx],
                 c.dictionary,
+                c.subset_stats(),
             )
         return Table(cols, nrows)
 

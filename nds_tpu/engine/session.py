@@ -150,7 +150,7 @@ class Catalog:
         return Table({c: e.device_cols[c] for c in columns}, e.nrows)
 
     def _to_device(self, name, arrow, e: _Entry):
-        t = table_from_arrow(arrow, e.schema)
+        t = table_from_arrow(arrow, e.schema, with_stats=True)
         mesh = self.session.mesh
         if mesh is None:
             return t
@@ -172,7 +172,8 @@ class Catalog:
                 spec = NamedSharding(mesh, PS())
             valid = None if c.valid is None else jax.device_put(c.valid, spec)
             cols[cname] = Col(
-                jax.device_put(c.data, spec), c.dtype, valid, c.dictionary
+                jax.device_put(c.data, spec), c.dtype, valid, c.dictionary,
+                c.stats,
             )
         return Table(cols, t.nrows)
 

@@ -442,6 +442,26 @@ class Parser:
                 self.expect_op(")")
                 alias = self.maybe_alias() or f"_subq{self.i}"
                 return A.SubqueryRef(q, alias)
+            # `((select ...) intersect (select ...)) alias`: a set expression
+            # whose first operand is itself parenthesized. Look past the
+            # leading parens; if a select starts there, parse the whole thing
+            # as one select expression (backtrack to a join group on failure).
+            k = 0
+            while (
+                self.peek(k).kind == "op" and self.peek(k).value == "("
+            ):
+                k += 1
+            if self.peek(k).kind == "kw" and self.peek(k).value in (
+                "select", "with",
+            ):
+                save = self.i
+                try:
+                    q = self.parse_select()
+                    self.expect_op(")")
+                    alias = self.maybe_alias() or f"_subq{self.i}"
+                    return A.SubqueryRef(q, alias)
+                except SyntaxError:
+                    self.i = save
             j = self.join_chain()
             self.expect_op(")")
             return j

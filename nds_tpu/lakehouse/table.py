@@ -181,7 +181,20 @@ class LakehouseTable:
         tmp = os.path.join(self.manifest_dir, f".tmp-{uuid.uuid4().hex}.json")
         with open(tmp, "w") as fh:
             json.dump(manifest, fh)
-        os.rename(tmp, os.path.join(self.manifest_dir, f"v{version:06d}.json"))
+        # optimistic concurrency: os.link refuses to clobber an existing
+        # manifest, so a concurrent writer that claimed the same version
+        # fails loudly instead of silently last-writer-winning (Iceberg's
+        # commit-conflict guarantee)
+        dest = os.path.join(self.manifest_dir, f"v{version:06d}.json")
+        try:
+            os.link(tmp, dest)
+        except FileExistsError:
+            os.unlink(tmp)
+            raise LakehouseError(
+                f"{self.path}: concurrent commit conflict at version "
+                f"{version}; retry the transaction"
+            )
+        os.unlink(tmp)
         return version
 
     def append(self, table, operation="append") -> int:

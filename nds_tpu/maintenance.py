@@ -116,8 +116,15 @@ def register_refresh_views(session, refresh_data_path, valid_queries=None):
     schemas = get_maintenance_schemas(session.use_decimal)
     for table in sorted(needed):
         path = os.path.join(refresh_data_path, table)
-        if os.path.isdir(path):
-            session.register_csv_dir(table, path, schemas[table])
+        if not os.path.isdir(path):
+            # fail now with the expected path, not mid-run as an opaque
+            # binder "unknown table" inside the timed maintenance window
+            raise FileNotFoundError(
+                f"staging table {table!r} required by the selected "
+                f"maintenance functions is missing: expected directory "
+                f"{path} (generate it with gen_data --update)"
+            )
+        session.register_csv_dir(table, path, schemas[table])
 
 
 def run_maintenance(

@@ -290,25 +290,12 @@ def matched_mask(li, ok, cap):
 # ---------------------------------------------------------------------------
 
 
-@jax.jit
-def _masked_min_max(data, mask):
-    info = jnp.iinfo(I64)
-    mn = jnp.where(mask, data, info.max).min()
-    mx = jnp.where(mask, data, info.min).max()
-    return mn, mx
-
-
-def masked_min_max(data, mask):
-    """(min, max) over masked int64 rows as host ints; min > max iff the mask
-    is empty (one fused device round-trip)."""
-    mn, mx = _masked_min_max(data, mask)
-    return int(mn), int(mx)
-
-
 @partial(jax.jit, static_argnames=("table_cap",))
 def dense_build(rkey, rlive, rmin, table_cap):
-    """Build presence/row-index/count tables over the key domain
-    [rmin, rmin+table_cap). Out-of-range and dead rows scatter to drop."""
+    """Build presence/row-index tables over the key domain
+    [rmin, rmin+table_cap). Out-of-range and dead rows scatter to drop.
+    Build-side uniqueness (needed by inner/left) is the caller's contract,
+    established from catalog ColStats — not re-checked on device."""
     slot = jnp.where(rlive, rkey.astype(I64) - rmin, jnp.int64(table_cap))
     slot = jnp.where((slot >= 0) & (slot <= table_cap), slot, table_cap)
     presence = jnp.zeros(table_cap, bool).at[slot].max(rlive, mode="drop")
@@ -317,12 +304,7 @@ def dense_build(rkey, rlive, rmin, table_cap):
         .at[slot]
         .max(jnp.arange(rkey.shape[0], dtype=jnp.int32), mode="drop")
     )
-    counts = (
-        jnp.zeros(table_cap, jnp.int32)
-        .at[slot]
-        .add(rlive.astype(jnp.int32), mode="drop")
-    )
-    return presence, rows, counts
+    return presence, rows
 
 
 @partial(jax.jit, static_argnames=("table_cap",))

@@ -114,6 +114,16 @@ QUERIES = [
     # date range on string-coerced dates
     """select count(*) c from date_dim
        where d_date between '1999-01-01' and '1999-12-31'""",
+    # NOT IN under OR (mark-join lowering; binder regression)
+    """select count(*) c from store_sales
+       where ss_store_sk = 1
+          or ss_item_sk not in (select i_item_sk from item
+                                where i_manager_id < 5)""",
+    # correlated EXISTS with a non-equi residual (q16/q94 shape)
+    """select count(*) c from store_sales s1
+       where exists (select 1 from store_sales s2
+                     where s1.ss_ticket_number = s2.ss_ticket_number
+                       and s1.ss_item_sk <> s2.ss_item_sk)""",
 ]
 
 
