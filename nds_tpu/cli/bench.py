@@ -1,0 +1,24 @@
+"""Whole-benchmark CLI (reference: nds/nds_bench.py __main__ :500-506).
+
+    python -m nds_tpu.cli.bench <bench.yml>
+"""
+
+import argparse
+
+from ..check import check_version
+from ..full_bench import get_yaml_params, run_full_bench
+
+
+def main(argv=None):
+    check_version()
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "yaml_config", help="yaml config file for the benchmark"
+    )
+    args = parser.parse_args(argv)
+    params = get_yaml_params(args.yaml_config)
+    run_full_bench(params)
+
+
+if __name__ == "__main__":
+    main()

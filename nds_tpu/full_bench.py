@@ -1,0 +1,301 @@
+"""Whole-benchmark orchestrator: the reference `nds_bench.py` equivalent.
+
+Phase plan (reference: nds/nds_bench.py:34-42):
+  0. data generation (+ per-stream --update refresh sets)   [not timed]
+  1. Load Test (transcode)                    -> Tload, RNGSEED timestamp
+  2. query stream generation (RNGSEED = load end timestamp, Spec 4.3.1)
+  3. Power Test                               -> Tpower
+  4. Throughput Test 1 (streams 1..S)         -> Ttt1
+  5. Maintenance Test 1 (refresh sets 1..S)   -> Tdm1
+  6. Throughput Test 2 (streams S+1..2S)      -> Ttt2
+  7. Maintenance Test 2 (refresh sets S+1..)  -> Tdm2
+  metric = int(SF * Sq*99 / (Tpt*Ttt*Tdm*Tld)^(1/4))   -> metrics.csv
+
+Each phase shells out to its CLI (process boundary, like the reference's
+subprocess.run of spark-submit) and state passes through report files on
+disk, so any phase can be skipped and resumed from prior reports
+(reference: nds_bench.py:367-497; skip semantics nds/README.md:499-503).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import subprocess
+import sys
+
+import yaml
+
+from .throughput import round_up_to_nearest_10_percent
+
+
+def get_yaml_params(yaml_file):
+    with open(yaml_file) as f:
+        return yaml.safe_load(f)
+
+
+# ---------------------------------------------------------------------------
+# report-file parsers (state passes between phases on disk)
+# ---------------------------------------------------------------------------
+
+
+def get_load_end_timestamp(load_report_file):
+    """RNGSEED for stream generation = load end timestamp (Spec 4.3.1);
+    re-read from the load report (reference: nds_bench.py:60-74)."""
+    with open(load_report_file) as f:
+        for line in f:
+            if "RNGSEED used" in line:
+                return int(line.split(":")[1].strip())
+    raise ValueError(
+        f"RNGSEED not found in load report {load_report_file}; "
+        "re-run the Load Test or fix the report path"
+    )
+
+
+def get_load_time(load_report_file):
+    with open(load_report_file) as f:
+        for line in f:
+            if "Load Test Time" in line:
+                return float(line.split(":")[1].strip().split(" ")[0])
+    raise ValueError(f"Load Test Time not found in {load_report_file}")
+
+
+def get_power_time(power_report_file):
+    """Power Test elapsed seconds from the CSV time log (ms -> s, rounded
+    up to 0.1 s; reference: nds_bench.py:92-104,207-208)."""
+    import csv
+
+    with open(power_report_file) as f:
+        for row in csv.reader(f):
+            if len(row) >= 3 and row[1] == "Power Test Time":
+                return round_up_to_nearest_10_percent(float(row[2]) / 1000)
+    raise ValueError(f"Power Test Time not found in {power_report_file}")
+
+
+def get_refresh_time(maintenance_report_file):
+    import csv
+
+    with open(maintenance_report_file) as f:
+        for row in csv.reader(f):
+            if len(row) >= 2 and row[1] == "Data Maintenance Time":
+                return float(row[2])
+    raise ValueError(
+        f"Data Maintenance Time not found in {maintenance_report_file}"
+    )
+
+
+def get_throughput_time(time_log_base, num_streams, first_or_second):
+    from .throughput import _read_start_end
+
+    starts, ends = [], []
+    for n in get_stream_range(num_streams, first_or_second):
+        s, e = _read_start_end(f"{time_log_base}_{n}.csv")
+        starts.append(s)
+        ends.append(e)
+    return round_up_to_nearest_10_percent(max(ends) - min(starts))
+
+
+def get_maintenance_time(report_base, num_streams, first_or_second):
+    tdm = 0.0
+    for i in get_stream_range(num_streams, first_or_second):
+        tdm += get_refresh_time(f"{report_base}_{i}.csv")
+    return round_up_to_nearest_10_percent(tdm)
+
+
+def get_stream_range(num_streams, first_or_second):
+    """Streams of one Throughput Test. num_streams=9: test 1 -> [1..4],
+    test 2 -> [5..8] (stream 0 is the Power stream;
+    reference: nds_bench.py:126-135)."""
+    if first_or_second == 1:
+        return list(range(1, num_streams // 2 + 1))
+    return list(range(num_streams // 2 + 1, num_streams))
+
+
+def get_throughput_stream_nums(num_streams, first_or_second):
+    return ",".join(str(x) for x in get_stream_range(num_streams, first_or_second))
+
+
+# ---------------------------------------------------------------------------
+# composite metric (reference: nds_bench.py:334-357)
+# ---------------------------------------------------------------------------
+
+
+def get_perf_metric(scale_factor, sq, tload, tpower, ttt1, ttt2, tdm1, tdm2):
+    """int(SF * Q / (Tpt*Ttt*Tdm*Tld)^(1/4)), quantities in decimal hours;
+    Q = Sq*99, Tld weighted 0.01*Sq (TPC-DS Spec 7.6.3)."""
+    q = sq * 99
+    tpt = (tpower * sq) / 3600
+    ttt = (ttt1 + ttt2) / 3600
+    tdm = (tdm1 + tdm2) / 3600
+    tld = (0.01 * sq * tload) / 3600
+    # reference truncates SF to int (nds_bench.py:356); float() keeps
+    # fractional smoke scales (SF<1) from collapsing the metric to 0 and is
+    # identical for the integral SFs the spec defines
+    return int(float(scale_factor) * q / (tpt * ttt * tdm * tld) ** (1 / 4))
+
+
+def write_metrics_report(report_path, metrics_map):
+    with open(report_path, "w") as f:
+        for key, value in metrics_map.items():
+            f.write(f"{key},{value}\n")
+
+
+# ---------------------------------------------------------------------------
+# phase runners (each a process boundary, like the reference's spark-submit)
+# ---------------------------------------------------------------------------
+
+
+def _run(cmd):
+    print("====== " + " ".join(str(c) for c in cmd) + " ======", flush=True)
+    subprocess.run([str(c) for c in cmd], check=True)
+
+
+def run_data_gen(params, num_streams):
+    cfg = params["data_gen"]
+
+    def gen(data_dir, extra):
+        _run([
+            sys.executable, "-m", "nds_tpu.cli.gen_data", "local",
+            "--scale", cfg["scale_factor"],
+            "--parallel", cfg["parallel"],
+            "--data_dir", data_dir,
+            "--overwrite_output",
+        ] + extra)
+
+    gen(cfg["raw_data_path"], [])
+    # one refresh set per non-power stream (maintenance phases consume them)
+    for i in range(1, num_streams):
+        gen(cfg["raw_data_path"] + f"_update{i}", ["--update", i])
+
+
+def run_load_test(params):
+    cfg = params["load_test"]
+    cmd = [
+        sys.executable, "-m", "nds_tpu.cli.transcode",
+        params["data_gen"]["raw_data_path"],
+        cfg["output_path"],
+        cfg["report_path"],
+        "--output_format", cfg.get("warehouse_format", "lakehouse"),
+        "--output_mode", "overwrite",
+    ]
+    _run(cmd)
+
+
+def gen_streams(params, num_streams, rngseed):
+    cfg = params["generate_query_stream"]
+    cmd = [
+        sys.executable, "-m", "nds_tpu.cli.gen_query_stream",
+        "--output_dir", cfg["stream_output_path"],
+        "--streams", num_streams,
+        "--scale", params["data_gen"]["scale_factor"],
+        "--rngseed", rngseed,
+    ]
+    if cfg.get("query_template_dir"):
+        cmd += ["--template_dir", cfg["query_template_dir"]]
+    _run(cmd)
+
+
+def power_test(params):
+    cfg = params["power_test"]
+    stream_dir = params["generate_query_stream"]["stream_output_path"]
+    cmd = [
+        sys.executable, "-m", "nds_tpu.cli.power",
+        params["load_test"]["output_path"],
+        os.path.join(stream_dir, "query_0.sql"),
+        cfg["report_path"],
+        "--input_format", params["load_test"].get("warehouse_format", "lakehouse"),
+    ]
+    if cfg.get("property_path"):
+        cmd += ["--property_file", cfg["property_path"]]
+    if cfg.get("output_path"):
+        cmd += ["--output_prefix", cfg["output_path"]]
+    _run(cmd)
+
+
+def throughput_test(params, num_streams, first_or_second):
+    cfg = params["throughput_test"]
+    stream_dir = params["generate_query_stream"]["stream_output_path"]
+    cmd = [
+        sys.executable, "-m", "nds_tpu.cli.throughput",
+        params["load_test"]["output_path"],
+        stream_dir,
+        get_throughput_stream_nums(num_streams, first_or_second),
+        cfg["report_base_path"],
+        "--input_format", params["load_test"].get("warehouse_format", "lakehouse"),
+    ]
+    _run(cmd)
+
+
+def maintenance_test(params, num_streams, first_or_second):
+    cfg = params["maintenance_test"]
+    for i in get_stream_range(num_streams, first_or_second):
+        refresh_dir = params["data_gen"]["raw_data_path"] + f"_update{i}"
+        cmd = [
+            sys.executable, "-m", "nds_tpu.cli.maintenance",
+            params["load_test"]["output_path"],
+            refresh_dir,
+            cfg["maintenance_report_base_path"] + f"_{i}.csv",
+        ]
+        if cfg.get("maintenance_queries"):
+            cmd += ["--maintenance_queries", cfg["maintenance_queries"]]
+        _run(cmd)
+
+
+# ---------------------------------------------------------------------------
+
+
+def run_full_bench(params):
+    num_streams = params["generate_query_stream"]["num_streams"]
+    if num_streams % 2 == 0:
+        raise ValueError(
+            f"num_streams must be odd (power stream + 2 equal throughput "
+            f"sets), got {num_streams}"
+        )
+    sq = num_streams // 2  # streams per Throughput Test
+    if not params["data_gen"].get("skip"):
+        run_data_gen(params, num_streams)
+    if not params["load_test"].get("skip"):
+        run_load_test(params)
+    load_report = params["load_test"]["report_path"]
+    tload = get_load_time(load_report)
+    if not params["generate_query_stream"].get("skip"):
+        gen_streams(params, num_streams, get_load_end_timestamp(load_report))
+    if not params["power_test"].get("skip"):
+        power_test(params)
+    tpower = get_power_time(params["power_test"]["report_path"])
+    tt_cfg = params["throughput_test"]
+    dm_cfg = params["maintenance_test"]
+    if not tt_cfg.get("skip"):
+        throughput_test(params, num_streams, 1)
+    ttt1 = get_throughput_time(tt_cfg["report_base_path"], num_streams, 1)
+    if not dm_cfg.get("skip"):
+        maintenance_test(params, num_streams, 1)
+    tdm1 = get_maintenance_time(
+        dm_cfg["maintenance_report_base_path"], num_streams, 1
+    )
+    if not tt_cfg.get("skip"):
+        throughput_test(params, num_streams, 2)
+    ttt2 = get_throughput_time(tt_cfg["report_base_path"], num_streams, 2)
+    if not dm_cfg.get("skip"):
+        maintenance_test(params, num_streams, 2)
+    tdm2 = get_maintenance_time(
+        dm_cfg["maintenance_report_base_path"], num_streams, 2
+    )
+    metric = get_perf_metric(
+        params["data_gen"]["scale_factor"], sq,
+        tload, tpower, ttt1, ttt2, tdm1, tdm2,
+    )
+    metrics = {
+        "scale_factor": params["data_gen"]["scale_factor"],
+        "num_streams": num_streams,
+        "Tload": tload,
+        "Tpower": tpower,
+        "Ttt1": ttt1,
+        "Ttt2": ttt2,
+        "Tdm1": tdm1,
+        "Tdm2": tdm2,
+        "perf_metric": metric,
+    }
+    print(metrics)
+    write_metrics_report(params["metrics_report_path"], metrics)
+    return metrics

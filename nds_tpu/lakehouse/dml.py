@@ -92,30 +92,157 @@ def _run_delete(session, stmt: A.DeleteStmt):
     table = _lake_table(session, stmt.table)
     before = table.dataset().count_rows()
     if stmt.where is None:
-        keep = None  # DELETE FROM t -> truncate
-    else:
-        # survivors: rows where the predicate is FALSE or NULL
-        keep = E.UnaryOp(
-            "not", E.Func("coalesce", (stmt.where, E.Lit(False)))
-        )
+        # DELETE FROM t -> truncate
+        target = table.schema()
+        if target is None:
+            raise LakehouseError(f"{stmt.table}: table has no schema")
+        version = table.replace(target.empty_table(), operation="delete")
+        session.catalog.invalidate(stmt.table.lower())
+        return DmlResult(before, version)
+
+    arrow_pred = _to_arrow_predicate(session, stmt.where)
+    if arrow_pred is not None:
+        # streaming copy-on-write: scan file-by-file with predicate pushdown
+        # and stage survivor batches directly — the survivor set never
+        # materializes on host (at SF3000 a ranged fact DELETE would
+        # otherwise round-trip billions of rows through one host's memory)
+        keep = arrow_pred.is_null() | ~arrow_pred  # NULL predicate survives
+        scanner = table.dataset().scanner(filter=keep, batch_size=1 << 20)
+        deleted = 0
+        version = None
+
+        def batches():
+            nonlocal deleted
+            survived = 0
+            for b in scanner.to_batches():
+                survived += b.num_rows
+                yield b
+            deleted = before - survived
+
+        version = table.replace(batches(), operation="delete")
+        session.catalog.invalidate(stmt.table.lower())
+        return DmlResult(deleted, version)
+
+    # engine fallback for predicates the Arrow translator can't express:
+    # survivors are rows where the predicate is FALSE or NULL
+    keep = E.UnaryOp("not", E.Func("coalesce", (stmt.where, E.Lit(False))))
     query = A.SelectStmt(
         select_items=[("*", None)],
         from_items=[A.TableRef(stmt.table)],
         where=keep,
     )
-    if keep is None:
-        target = table.schema()
-        if target is None:
-            raise LakehouseError(f"{stmt.table}: table has no schema")
-        survivors = target.empty_table()
-    else:
-        survivors = session.run_stmt(query).collect()
-        target = table.schema()
-        if target is not None:
-            survivors = _cast_to_schema(survivors, target)
+    survivors = session.run_stmt(query).collect()
+    target = table.schema()
+    if target is not None:
+        survivors = _cast_to_schema(survivors, target)
     version = table.replace(survivors, operation="delete")
     session.catalog.invalidate(stmt.table.lower())
     return DmlResult(before - survivors.num_rows, version)
+
+
+def _to_arrow_predicate(session, e):
+    """Translate a DELETE predicate into a pyarrow dataset expression,
+    evaluating scalar subqueries through the engine first (DF_* predicates
+    are ranged comparisons against date-keyed scalar subqueries; reference:
+    nds/data_maintenance/DF_SS.sql:30-33). Returns None when the predicate
+    uses something the translator doesn't cover (caller falls back to the
+    engine path)."""
+    import datetime
+
+    import pyarrow.dataset as pads
+
+    from ..engine import expr as EX
+
+    class _Unsupported(Exception):
+        pass
+
+    def scalar_value(sub):
+        res = session.run_stmt(sub.query)
+        t = res.collect()
+        if t.num_rows == 0:
+            raise _Unsupported()  # NULL scalar: engine path handles 3VL
+        v = t.column(0)[0].as_py()
+        if v is None:
+            raise _Unsupported()
+        return v
+
+    def lit_value(x):
+        if x.dtype is not None and x.dtype.kind == "date" and isinstance(
+            x.value, str
+        ):
+            y, m, d = x.value.split("-")
+            return datetime.date(int(y), int(m), int(d))
+        return x.value
+
+    def rec(x):
+        if isinstance(x, EX.Lit):
+            return lit_value(x)
+        if isinstance(x, EX.Col):
+            return pads.field(x.name)
+        if isinstance(x, EX.SubqueryExpr):
+            if x.kind != "scalar":
+                raise _Unsupported()
+            return scalar_value(x)
+        if isinstance(x, EX.Cast):
+            # only the date-of-string-literal form translates exactly; any
+            # other cast would silently change comparison semantics
+            if x.target.kind == "date":
+                inner = x.operand
+                if isinstance(inner, EX.Lit) and isinstance(inner.value, str):
+                    y, m, d = inner.value.split("-")
+                    return datetime.date(int(y), int(m), int(d))
+            raise _Unsupported()
+        if isinstance(x, EX.Between):
+            op = as_expr(rec(x.operand))
+            lo, hi = rec(x.low), rec(x.high)
+            out = (op >= lo) & (op <= hi)
+            return ~out if x.negated else out
+        if isinstance(x, EX.InList):
+            op = as_expr(rec(x.operand))
+            vals = [rec(v) for v in x.values]
+            if any(v is None for v in vals):
+                # NULL in the IN list: Arrow isin is 2-valued, SQL is 3VL
+                raise _Unsupported()
+            out = op.isin(vals)
+            return ~out if x.negated else out
+        if isinstance(x, EX.UnaryOp):
+            if x.op == "not":
+                return ~as_expr(rec(x.operand))
+            if x.op == "isnull":
+                return as_expr(rec(x.operand)).is_null()
+            if x.op == "isnotnull":
+                return as_expr(rec(x.operand)).is_valid()
+            raise _Unsupported()
+        if isinstance(x, EX.BinOp):
+            a, b = rec(x.left), rec(x.right)
+            if x.op in ("and", "or"):
+                a, b = as_expr(a), as_expr(b)
+                return a & b if x.op == "and" else a | b
+            if not isinstance(a, pads.Expression) and not isinstance(
+                b, pads.Expression
+            ):
+                # literal-vs-literal comparison folds to a Python bool,
+                # which cannot participate in an Arrow filter
+                raise _Unsupported()
+            ops = {
+                "=": lambda: a == b, "<>": lambda: a != b,
+                "<": lambda: a < b, "<=": lambda: a <= b,
+                ">": lambda: a > b, ">=": lambda: a >= b,
+            }
+            if x.op not in ops:
+                raise _Unsupported()
+            return ops[x.op]()
+        raise _Unsupported()
+
+    def as_expr(v):
+        if not isinstance(v, pads.Expression):
+            raise _Unsupported()
+        return v
+
+    try:
+        return as_expr(rec(e))
+    except _Unsupported:
+        return None
 
 
 def _run_ctas(session, stmt: A.CreateTableStmt):
