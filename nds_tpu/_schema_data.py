@@ -6,6 +6,12 @@ non-nullable. Generated from spec facts; formatting is ours.
 """
 
 SOURCE_TABLES = {
+'dbgen_version': """\
+    dv_version       varchar(16)
+    dv_create_date   date
+    dv_create_time   char(20)
+    dv_cmdline_args  varchar(200)
+""",
 'customer_address': """\
     ca_address_sk     int32  !
     ca_address_id     char(16)  !

@@ -80,12 +80,32 @@ def _wait_all(procs, what):
         raise Exception(f"{what} failed with return code(s) {failed}")
 
 
+def _write_dbgen_version(args):
+    """One-row version/audit table (reference: dsdgen emits dbgen_version,
+    moved into place by nds_gen_data.py:50-51). Not emitted for refresh
+    (--update) sets, matching the reference's source-table list."""
+    if args.update:
+        return
+    import datetime
+
+    now = datetime.datetime.now()
+    d = os.path.join(args.data_dir, "dbgen_version")
+    os.makedirs(d, exist_ok=True)
+    cmdline = f"-scale {args.scale} -parallel {args.parallel}"
+    row = (
+        f"1.0.0|{now:%Y-%m-%d}|{now:%H:%M:%S}|{cmdline}|\n"
+    )
+    with open(os.path.join(d, "dbgen_version_1_1.dat"), "w") as f:
+        f.write(row)
+
+
 def generate_data_local(args, children):
     binary = check.check_build()
     _guard_output_dir(args)
     procs = [subprocess.Popen(cmd) for cmd in _chunk_cmds(binary, args, children)]
     _wait_all(procs, "ndsgen")
     _layout_tables(args, children)
+    _write_dbgen_version(args)
     subprocess.run(["du", "-h", "-d1", args.data_dir])
 
 
@@ -107,6 +127,7 @@ def generate_data_cluster(args, children):
             procs.append(subprocess.Popen(["ssh", host] + cmd))
     _wait_all(procs, "remote ndsgen")
     _layout_tables(args, children)
+    _write_dbgen_version(args)
 
 
 def generate_data(args):

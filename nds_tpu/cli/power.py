@@ -31,7 +31,7 @@ def main(argv=None):
     )
     parser.add_argument(
         "--input_format",
-        choices=["parquet", "csv", "lakehouse"],
+        choices=["parquet", "csv", "orc", "lakehouse"],
         default="parquet",
         help="type of the input data source",
     )

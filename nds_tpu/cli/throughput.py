@@ -29,7 +29,7 @@ def main(argv=None):
     )
     parser.add_argument(
         "--input_format",
-        choices=["parquet", "csv", "lakehouse"],
+        choices=["parquet", "csv", "orc", "lakehouse"],
         default="parquet",
     )
     parser.add_argument("--property_file")

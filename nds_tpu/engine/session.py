@@ -165,9 +165,24 @@ class Catalog:
 
         n_dev = mesh.devices.size
         cols = {}
+        warned = False
         for cname, c in t.columns.items():
-            if name in TABLE_PARTITIONING and c.data.shape[0] % n_dev == 0:
-                spec = NamedSharding(mesh, PS("data"))
+            if name in TABLE_PARTITIONING:
+                if c.data.shape[0] % n_dev == 0:
+                    spec = NamedSharding(mesh, PS("data"))
+                else:
+                    # capacities are power-of-two buckets, so this only
+                    # happens on a non-power-of-two mesh (or cap < n_dev);
+                    # never degrade a fact table to full replication silently
+                    spec = NamedSharding(mesh, PS())
+                    if not warned:
+                        warned = True
+                        self.session.notify_failure(
+                            f"sharding fallback: fact table {name!r} "
+                            f"(cap {c.data.shape[0]}) is not divisible by "
+                            f"the {n_dev}-device mesh; replicating instead "
+                            f"of row-sharding"
+                        )
             else:
                 spec = NamedSharding(mesh, PS())
             valid = None if c.valid is None else jax.device_put(c.valid, spec)
@@ -214,15 +229,19 @@ class Result:
 
         pq.write_table(self.collect(), path)
 
-    def write(self, path, fmt="parquet"):
+    def write(self, path, fmt="parquet", transform=None):
         """Write the result as a single-file dataset dir `path/part-0.<fmt>`
         (the layout the validator reads back; reference analogue:
-        df.write.format(fmt).save(path), nds/nds_power.py:132-135)."""
+        df.write.format(fmt).save(path), nds/nds_power.py:132-135).
+        `transform(arrow) -> arrow` hooks callers like the Power Run's
+        column-name sanitizer in before the write."""
         import pyarrow.csv as pacsv
         import pyarrow.parquet as pq
 
         os.makedirs(path, exist_ok=True)
         arrow = self.collect()
+        if transform is not None:
+            arrow = transform(arrow)
         if fmt == "parquet":
             pq.write_table(arrow, os.path.join(path, "part-0.parquet"))
         elif fmt == "csv":
@@ -256,6 +275,11 @@ class Session:
     def register_parquet(self, name, path, schema=None):
         self.catalog.entries[name.lower()] = _Entry(
             schema=schema, path=path, fmt="parquet"
+        )
+
+    def register_orc(self, name, path, schema=None):
+        self.catalog.entries[name.lower()] = _Entry(
+            schema=schema, path=path, fmt="orc"
         )
 
     def register_csv_dir(self, name, path, schema):

@@ -246,10 +246,11 @@ def maintenance_test(params, num_streams, first_or_second):
 
 def run_full_bench(params):
     num_streams = params["generate_query_stream"]["num_streams"]
-    if num_streams % 2 == 0:
+    if num_streams % 2 == 0 or num_streams < 3:
         raise ValueError(
-            f"num_streams must be odd (power stream + 2 equal throughput "
-            f"sets), got {num_streams}"
+            f"num_streams must be odd and >= 3 (power stream + 2 equal "
+            f"non-empty throughput sets; Spec 4.3.2 wants 2*S+1, S>=4), "
+            f"got {num_streams}"
         )
     sq = num_streams // 2  # streams per Throughput Test
     if not params["data_gen"].get("skip"):

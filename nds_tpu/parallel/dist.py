@@ -142,3 +142,134 @@ def partition_exchange(mesh: Mesh, cap_per_dev: int):
         out_specs=(P("data"), P("data"), P()),
     )
     return jax.jit(fn)
+
+
+# ---------------------------------------------------------------------------
+# Distributed hash join: the full shuffle join for fact-fact shapes
+# (store_sales x store_returns and friends). Both sides hash-partition on the
+# join-key hash with all_to_all over ICI, then every device joins its
+# partition locally with static shapes — no host round-trips inside the
+# compiled step. The executor drives capacity-overflow retries.
+# ---------------------------------------------------------------------------
+
+
+def _route(h, live, n_dev, cap, cols):
+    """Pack rows into [n_dev, cap] buckets by hash destination and exchange.
+    Returns (recv_hash [n_dev*cap], recv_live, recv_cols, overflow)."""
+    dest = (h.astype(jnp.uint64) % jnp.uint64(n_dev)).astype(jnp.int32)
+    mdest = jnp.where(live, dest, n_dev)
+    order = jnp.argsort(mdest)
+    msorted = mdest[order]
+    base = jnp.searchsorted(msorted, jnp.arange(n_dev), side="left")
+    row = jnp.where(msorted < n_dev, msorted, n_dev)
+    pos = jnp.arange(h.shape[0]) - base[jnp.clip(row, 0, n_dev - 1)]
+    overflow = ((msorted < n_dev) & (pos >= cap)).sum()
+    row = jnp.where(pos < cap, row, n_dev)
+
+    def scatter(x, fill):
+        buf = jnp.full((n_dev, cap), fill, x.dtype)
+        buf = buf.at[row, pos].set(x[order], mode="drop")
+        return jax.lax.all_to_all(buf, "data", 0, 0, tiled=True).reshape(-1)
+
+    rh = scatter(h, jnp.zeros((), h.dtype))
+    rlive = scatter(live, False)
+    rcols = [scatter(c, jnp.zeros((), c.dtype)) for c in cols]
+    return rh, rlive, rcols, jax.lax.psum(overflow, "data")
+
+
+def exchange_hash_join(
+    mesh: Mesh,
+    n_lkeys: int,
+    n_lcols: int,
+    n_rcols: int,
+    cap_l: int,
+    cap_r: int,
+    pair_cap: int,
+):
+    """Factory for the mesh fact-fact inner join step.
+
+    The returned jitted fn takes
+      (l_hash, l_live, l_keys..., l_cols...),
+      (r_hash, r_live, r_keys..., r_cols...)
+    as flat tuples and returns per-device-concatenated pair outputs:
+      (pair_ok [n_dev*pair_cap], l_out cols..., r_out cols...,
+       overflow scalar)
+    where pair_ok marks verified join pairs (hash candidates re-checked
+    against the real key columns, so collisions can never fabricate rows)
+    and overflow > 0 means some bucket or pair capacity was exceeded — the
+    caller must retry with larger caps (executor emits a task-failure event
+    and doubles, like a Spark shuffle-spill retry).
+    """
+    n_dev = mesh.devices.size
+    imax = jnp.iinfo(jnp.int64).max
+    imin = jnp.iinfo(jnp.int64).min
+
+    def local(largs, rargs):
+        lh, llive, *lrest = largs
+        rh, rlive, *rrest = rargs
+        lkeys, lcols = lrest[:n_lkeys], lrest[n_lkeys:]
+        rkeys, rcols = rrest[:n_lkeys], rrest[n_lkeys:]
+        lh2, llive2, lship, ovl = _route(
+            lh, llive, n_dev, cap_l, list(lkeys) + list(lcols)
+        )
+        rh2, rlive2, rship, ovr = _route(
+            rh, rlive, n_dev, cap_r, list(rkeys) + list(rcols)
+        )
+        lkeys2, lcols2 = lship[:n_lkeys], lship[n_lkeys:]
+        rkeys2, rcols2 = rship[:n_lkeys], rship[n_lkeys:]
+        # local sorted-probe join with a fixed pair capacity
+        rh_m = jnp.where(rlive2, rh2, imax)
+        order = jnp.argsort(rh_m).astype(jnp.int32)
+        rh_sorted = rh_m[order]
+        lh_m = jnp.where(llive2, lh2, imin)
+        lo = jnp.searchsorted(rh_sorted, lh_m, side="left").astype(jnp.int32)
+        hi = jnp.searchsorted(rh_sorted, lh_m, side="right").astype(jnp.int32)
+        counts = jnp.where(llive2, hi - lo, 0)
+        offs = jnp.cumsum(counts) - counts
+        total = jnp.sum(counts)
+        p = jnp.arange(pair_cap, dtype=jnp.int64)
+        li = jnp.searchsorted(offs + counts, p, side="right").astype(jnp.int32)
+        li = jnp.clip(li, 0, lh2.shape[0] - 1)
+        j = (p - offs[li]).astype(jnp.int32)
+        ri = order[jnp.clip(lo[li] + j, 0, rh2.shape[0] - 1)]
+        ok = (p < total) & llive2[li] & rlive2[ri]
+        for a, b in zip(lkeys2, rkeys2):
+            ok = ok & (a[li] == b[ri])
+        ov_pairs = jnp.maximum(total - pair_cap, 0)
+        overflow = ovl + ovr + jax.lax.psum(ov_pairs, "data")
+        l_out = [c[li] for c in lcols2]
+        r_out = [c[ri] for c in rcols2]
+        return (ok, *l_out, *r_out, overflow)
+
+    out_specs = (
+        (P("data"),)
+        + tuple(P("data") for _ in range(n_lcols + n_rcols))
+        + (P(),)
+    )
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            tuple(P("data") for _ in range(2 + n_lkeys + n_lcols)),
+            tuple(P("data") for _ in range(2 + n_lkeys + n_rcols)),
+        ),
+        out_specs=out_specs,
+    )
+    return jax.jit(fn)
+
+
+_XJOIN_CACHE = {}
+
+
+def get_exchange_hash_join(mesh, n_lkeys, n_lcols, n_rcols, cap_l, cap_r, pair_cap):
+    """Cached factory: one compiled exchange-join step per signature, so
+    repeated joins across a query stream reuse the XLA executable. Keyed by
+    the mesh's device topology (not object identity, which a recycled id()
+    could alias after GC)."""
+    topo = tuple(d.id for d in mesh.devices.flat)
+    key = (topo, n_lkeys, n_lcols, n_rcols, cap_l, cap_r, pair_cap)
+    if key not in _XJOIN_CACHE:
+        _XJOIN_CACHE[key] = exchange_hash_join(
+            mesh, n_lkeys, n_lcols, n_rcols, cap_l, cap_r, pair_cap
+        )
+    return _XJOIN_CACHE[key]

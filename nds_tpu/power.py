@@ -64,6 +64,8 @@ def setup_tables(session, input_prefix, input_format, use_decimal, execution_tim
                 session.register_csv_warehouse(table_name, table_path, schema)
         elif input_format == "parquet":
             session.register_parquet(table_name, table_path, schema)
+        elif input_format == "orc":
+            session.register_orc(table_name, table_path, schema)
         elif input_format == "lakehouse":
             session.register_lakehouse(table_name, table_path, schema)
         else:
@@ -77,6 +79,25 @@ def setup_tables(session, input_prefix, input_format, use_decimal, execution_tim
     return execution_time_list
 
 
+def ensure_valid_column_names(arrow_table):
+    """Sanitize result column names before writing: invalid characters become
+    underscores and duplicates get a positional suffix (reference:
+    nds/nds_power.py:137-174 — parquet writers reject ` ,;{}()\\n\\t=`)."""
+    import re
+
+    invalid = re.compile(r"[ ,;{}()\n\t=]")
+    names, seen = [], {}
+    for n in arrow_table.column_names:
+        clean = invalid.sub("_", n)
+        if clean in seen:
+            seen[clean] += 1
+            clean = f"{clean}_{seen[clean]}"
+        else:
+            seen[clean] = 0
+        names.append(clean)
+    return arrow_table.rename_columns(names)
+
+
 def run_one_query(session, query, query_name, output_path, output_format):
     """Execute one stream entry; collect to host, or write for validation
     (reference: nds/nds_power.py:125-135)."""
@@ -87,7 +108,7 @@ def run_one_query(session, query, query_name, output_path, output_format):
         result.collect()
     else:
         dest = os.path.join(output_path, query_name)
-        result.write(dest, output_format)
+        result.write(dest, output_format, transform=ensure_valid_column_names)
 
 
 def load_properties(filename: str) -> dict:

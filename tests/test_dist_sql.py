@@ -41,10 +41,12 @@ def _synth_tables(n_fact=4096, n_dates=256, n_items=128, n_stores=8, seed=0):
     )
     price = np.round(rng.random(n_fact) * 100, 2)
     price[rng.random(n_fact) < 0.05] = np.nan
+    tickets = rng.integers(1, n_fact // 2, n_fact)
     store_sales = pa.table(
         {
             "ss_sold_date_sk": rng.integers(2450000, 2450000 + n_dates, n_fact),
             "ss_item_sk": rng.integers(1, n_items + 1, n_fact),
+            "ss_ticket_number": tickets,
             "ss_store_sk": pa.array(
                 np.where(
                     rng.random(n_fact) < 0.03,
@@ -59,11 +61,31 @@ def _synth_tables(n_fact=4096, n_dates=256, n_items=128, n_stores=8, seed=0):
             ),
         }
     )
+    # returns: half sampled from real sales (matching ticket+item), half junk
+    n_ret = n_fact // 2
+    pick = rng.integers(0, n_fact, n_ret // 2)
+    ret_items = np.concatenate(
+        [
+            np.asarray(store_sales.column("ss_item_sk"))[pick],
+            rng.integers(1, n_items + 1, n_ret - n_ret // 2),
+        ]
+    )
+    ret_tickets = np.concatenate(
+        [tickets[pick], rng.integers(n_fact, 2 * n_fact, n_ret - n_ret // 2)]
+    )
+    store_returns = pa.table(
+        {
+            "sr_item_sk": ret_items,
+            "sr_ticket_number": ret_tickets,
+            "sr_return_amt": np.round(rng.random(n_ret) * 50, 2),
+        }
+    )
     return {
         "date_dim": date_dim,
         "item": item,
         "store": store,
         "store_sales": store_sales,
+        "store_returns": store_returns,
     }
 
 
@@ -135,6 +157,81 @@ def test_distributed_matches_oracle(oracle, dist, qname):
                 assert abs(x - y) < 1e-9 or (np.isnan(x) and np.isnan(y))
             else:
                 assert x == y, (qname, col, x, y)
+
+
+FACT_FACT_Q = """
+    select ss_item_sk, count(*) c, sum(sr_return_amt) s
+    from store_sales, store_returns
+    where ss_item_sk = sr_item_sk and ss_ticket_number = sr_ticket_number
+    group by ss_item_sk
+    order by ss_item_sk
+"""
+
+
+def test_exchange_join_matches_oracle():
+    """Mesh fact-fact join: both sides row-sharded, hash-partitioned over the
+    exchange, joined locally — must equal the single-device sort join
+    (VERDICT r2 item #6; reference analogue: Spark shuffle join)."""
+    conf = {"engine.exchange_min_rows": 1}
+    oracle = Session(conf=conf)
+    dist = Session(mesh=make_mesh(N_DEV), conf=conf)
+    for name, t in _synth_tables().items():
+        oracle.register_arrow(name, t)
+        dist.register_arrow(name, t)
+    failures = []
+    dist.register_listener(failures.append)
+    a = oracle.sql(FACT_FACT_Q).collect()
+    b = dist.sql(FACT_FACT_Q).collect()
+    assert a.num_rows == b.num_rows and a.num_rows > 0
+    for col in a.schema.names:
+        for x, y in zip(a.column(col).to_pylist(), b.column(col).to_pylist()):
+            if isinstance(x, float):
+                assert abs(x - y) < 1e-6, (col, x, y)
+            else:
+                assert x == y, (col, x, y)
+
+
+def test_exchange_join_overflow_retries():
+    """Skewed keys overflow the first capacity guess; the join must retry
+    with doubled caps, emit a task-failure event, and still be correct."""
+    rng = np.random.default_rng(7)
+    n = 4096
+    # 90% of rows share ONE key: that destination's bucket (and its local
+    # pair count) overflow the 2x-balanced initial capacity
+    # sparse key domain keeps the dense star-join path out of the way
+    skew = np.where(rng.random(n) < 0.9, 7, rng.integers(0, 256, n))
+    skew = skew * 1_000_003
+    left = pa.table({"k": skew, "lv": np.arange(n, dtype=np.int64)})
+    right = pa.table(
+        {"k": np.arange(256, dtype=np.int64) * 1_000_003,
+         "rv": np.arange(256, dtype=np.int64)}
+    )
+    conf = {"engine.exchange_min_rows": 1}
+    oracle = Session(conf=conf)
+    dist = Session(mesh=make_mesh(N_DEV), conf=conf)
+    for s in (oracle, dist):
+        s.register_arrow("l", left)
+        s.register_arrow("r", right)
+    failures = []
+    dist.register_listener(failures.append)
+    q = "select count(*) c, sum(lv) sl, sum(rv) sr from l, r where l.k = r.k"
+    a = oracle.sql(q).collect()
+    b = dist.sql(q).collect()
+    assert a.to_pylist() == b.to_pylist()
+    assert any("exchange join" in f for f in failures)
+
+
+def test_sharding_fallback_is_loud():
+    """A mesh that can't divide the fact-table capacity must announce the
+    replication fallback through the listener chain, never degrade silently
+    (VERDICT r2 weak #3)."""
+    s = Session(mesh=make_mesh(3))
+    events = []
+    s.register_listener(events.append)
+    for name, t in _synth_tables().items():
+        s.register_arrow(name, t)
+    s.catalog.load("store_sales", ["ss_item_sk"])
+    assert any("sharding fallback" in e for e in events)
 
 
 def test_fact_columns_are_row_sharded(dist):

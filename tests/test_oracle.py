@@ -21,6 +21,16 @@ from nds_tpu.schema import get_schemas
 DATA = "/tmp/nds_test_sf001"
 TABLES = ("store_sales", "store_returns", "item", "date_dim", "store", "customer")
 
+# sqlite can't express these constructs, so templates using them are
+# validated by the engine-vs-engine paths instead (dist oracle, row bounds):
+#   interval date arithmetic, ROLLUP/GROUPING, stddev_samp,
+#   CAST(... AS date/int) (sqlite CAST has numeric affinity: '2000-01-01'
+#   AS DATE -> 2000), typed `date '...'` literals
+_SQLITE_INCOMPATIBLE = (
+    "interval", "rollup", "grouping", "stddev_samp", "as date)", " as date",
+    "as int)", "as decimal",
+)
+
 
 @pytest.fixture(scope="module")
 def data_dir():
@@ -35,14 +45,14 @@ def data_dir():
     return DATA
 
 
-@pytest.fixture(scope="module")
-def engines(data_dir):
-    """(engine session, sqlite connection) over identical float-typed data."""
+def _load_engines(data_dir, tables):
     sess = Session(use_decimal=False)
     conn = sqlite3.connect(":memory:")
-    for t in TABLES:
+    for t in tables:
         schema = get_schemas(use_decimal=False)[t]
         path = os.path.join(data_dir, t)
+        if not os.path.isdir(path):
+            continue
         sess.register_csv_dir(t, path, schema)
         arrow = read_dat_dir(path, schema, use_decimal=False)
         cols = ", ".join(f'"{f.name}"' for f in schema)
@@ -61,6 +71,12 @@ def engines(data_dir):
         ph = ", ".join("?" for _ in schema)
         conn.executemany(f"insert into {t} ({cols}) values ({ph})", rows)
     return sess, conn
+
+
+@pytest.fixture(scope="module")
+def engines(data_dir):
+    """(engine session, sqlite connection) over identical float-typed data."""
+    return _load_engines(data_dir, TABLES)
 
 
 # Queries valid in BOTH dialects (dates as ISO strings: sqlite compares them
@@ -160,4 +176,85 @@ def test_engine_matches_sqlite(engines, qi):
         oracle.sort(key=str)
     assert _rows_close(ours, oracle), (
         f"query {qi} mismatch:\nengine: {ours[:5]}\nsqlite: {oracle[:5]}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# The actual instantiated templates vs sqlite (VERDICT r2 item #5): every
+# template whose dialect sqlite can express runs on both engines at SF0.01.
+# ---------------------------------------------------------------------------
+
+
+def _template_sql(qnum):
+    import numpy as np
+
+    from nds_tpu.datagen import query_streams as QS
+
+    rng = np.random.default_rng(1000 + qnum)
+    return QS.instantiate(qnum, rng, 0.01)
+
+
+# sqlite divides int/int as integer (1/2 = 0); the engine follows the
+# reference's Spark dialect (int/int -> double). These templates divide
+# integer columns, so the two dialects legitimately disagree:
+_INT_DIVISION_TEMPLATES = {34, 78, 83}
+
+
+def _sqlite_compatible():
+    from nds_tpu.datagen import query_streams as QS
+
+    out = []
+    for q in QS.available_templates():
+        if q in _INT_DIVISION_TEMPLATES:
+            continue
+        sql = _template_sql(q).lower()
+        if ";" in sql:
+            continue  # two-part templates
+        if any(tok in sql for tok in _SQLITE_INCOMPATIBLE):
+            continue
+        out.append(q)
+    return out
+
+
+@pytest.fixture(scope="module")
+def all_engines(data_dir):
+    from nds_tpu.schema import get_schemas as _gs
+
+    return _load_engines(data_dir, sorted(_gs(use_decimal=False)))
+
+
+@pytest.mark.parametrize("qnum", _sqlite_compatible())
+def test_template_matches_sqlite(all_engines, qnum):
+    import datetime
+    import time as _time
+
+    sess, conn = all_engines
+    sql = _template_sql(qnum)
+    # abort sqlite after 60s: its un-indexed nested-loop plans (q13-class
+    # OR-joins against the 1.9M-row demographics tables) would run for hours
+    deadline = _time.monotonic() + 60
+
+    def _abort_if_late():
+        return 1 if _time.monotonic() > deadline else 0
+
+    conn.set_progress_handler(_abort_if_late, 100_000)
+    try:
+        oracle = [list(r) for r in conn.execute(sql).fetchall()]
+    except sqlite3.OperationalError as e:
+        pytest.skip(f"sqlite can't run query{qnum}: {e}")
+    finally:
+        conn.set_progress_handler(None, 0)
+
+    def plain(v):
+        return v.isoformat() if isinstance(v, datetime.date) else v
+
+    ours = [
+        [plain(v) for v in r.values()] for r in sess.sql(sql).to_pylist()
+    ]
+    if "order by" not in sql.lower():
+        ours.sort(key=str)
+        oracle.sort(key=str)
+    assert _rows_close(ours, oracle, eps=1e-4), (
+        f"query{qnum} mismatch ({len(ours)} vs {len(oracle)} rows):\n"
+        f"engine: {ours[:3]}\nsqlite: {oracle[:3]}"
     )

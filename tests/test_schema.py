@@ -7,7 +7,7 @@ from nds_tpu.dtypes import parse_dtype, DType, common_numeric, FLOAT64, INT64
 
 def test_source_table_count_and_columns():
     s = schema.get_schemas()
-    assert len(s) == 24
+    assert len(s) == 25  # 24 data tables + dbgen_version (reference: nds_gen_data.py:50-51)
     assert len(s["store_sales"]) == 23
     assert len(s["date_dim"]) == 28
     assert len(s["catalog_sales"]) == 34
