@@ -109,23 +109,56 @@ def generate_data_local(args, children):
     subprocess.run(["du", "-h", "-d1", args.data_dir])
 
 
+def _spawn_on_host(host, cmd):
+    """Launch one chunk command, locally or through ssh. Split out so tests
+    can observe/replace the launch mechanism without a real cluster."""
+    if host in ("localhost", "127.0.0.1"):
+        return subprocess.Popen(cmd)
+    return subprocess.Popen(["ssh", host] + cmd)
+
+
 def generate_data_cluster(args, children):
     """Fan chunks across hosts over ssh; every host writes to the shared
-    data_dir (NFS/GCS-fuse). Hosts file: one hostname per line."""
+    data_dir (NFS/GCS-fuse). Hosts file: one hostname per line.
+
+    A chunk whose process exits non-zero (host down, ssh hiccup, OOM) is
+    retried up to --retries times, each attempt rotated to the next host in
+    the list so a single dead host can't wedge the run — the elastic-recovery
+    counterpart of MapReduce task retries in the reference's Hadoop wrapper
+    (reference: nds/tpcds-gen/.../GenTable.java:140-167, where MR re-executes
+    failed map tasks)."""
     binary = check.check_build()
     with open(args.hosts) as f:
         hosts = [h.strip() for h in f if h.strip() and not h.strip().startswith("#")]
     if not hosts:
         raise Exception(f"no hosts in {args.hosts}")
     _guard_output_dir(args)
-    procs = []
-    for n, cmd in enumerate(_chunk_cmds(binary, args, children)):
-        host = hosts[n % len(hosts)]
-        if host in ("localhost", "127.0.0.1"):
-            procs.append(subprocess.Popen(cmd))
-        else:
-            procs.append(subprocess.Popen(["ssh", host] + cmd))
-    _wait_all(procs, "remote ndsgen")
+    # pending: chunk index (within this run's command list) -> attempt count
+    cmds = _chunk_cmds(binary, args, children)
+    attempts = {n: 0 for n in range(len(cmds))}
+    pending = list(attempts)
+    while pending:
+        procs = {}
+        for n in pending:
+            host = hosts[(n + attempts[n]) % len(hosts)]
+            attempts[n] += 1
+            procs[n] = (host, _spawn_on_host(host, cmds[n]))
+        failed = []
+        for n, (host, p) in procs.items():
+            p.wait()
+            if p.returncode != 0:
+                failed.append((n, host, p.returncode))
+        pending = []
+        for n, host, rc in failed:
+            if attempts[n] <= args.retries:
+                print(f"chunk {n + 1}/{len(cmds)} failed on {host} "
+                      f"(rc={rc}); retry {attempts[n]}/{args.retries}",
+                      file=sys.stderr)
+                pending.append(n)
+            else:
+                raise Exception(
+                    f"chunk {n + 1}/{len(cmds)} failed on {host} (rc={rc}) "
+                    f"after {args.retries} retries")
     _layout_tables(args, children)
     _write_dbgen_version(args)
 
@@ -162,6 +195,8 @@ def main(argv=None):
     parser.add_argument("--overwrite_output", action="store_true",
                         help="overwrite existing data in data_dir")
     parser.add_argument("--hosts", default="hosts.txt", help="hosts file for cluster mode")
+    parser.add_argument("--retries", type=int, default=2,
+                        help="cluster mode: retry a failed chunk up to <n> times on rotated hosts")
     args = parser.parse_args(argv)
     generate_data(args)
 

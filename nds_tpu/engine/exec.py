@@ -122,8 +122,91 @@ class Executor:
             if nf is None:
                 nf = asc  # Spark: NULLS FIRST for ASC, NULLS LAST for DESC
             keys.append((data, col.valid, asc, nf))
+        dist = self._try_dist_sort(child, keys)
+        if dist is not None:
+            return dist
         order = K.sort_indices(keys, child.row_mask())
         return self._take(child, order, child.nrows)
+
+    # -- distributed sort -------------------------------------------------
+    # ORDER BY over a mesh-sharded table: range-partitioned samplesort +
+    # global rank compaction over ICI (nds_tpu/parallel/dist.py:sample_sort)
+    # instead of the all-gathering lexsort the generic path would lower to.
+    _DIST_SORT_MIN_ROWS = 1 << 18
+
+    def _try_dist_sort(self, child: Table, keys):
+        session = getattr(self.catalog, "session", None)
+        mesh = getattr(session, "mesh", None)
+        if mesh is None:
+            return None
+        min_rows = int(
+            session.conf.get("engine.dist_sort_min_rows", self._DIST_SORT_MIN_ROWS)
+        )
+        if child.nrows < min_rows:
+            return None
+        n_dev = mesh.devices.size
+        cap = child.cap
+        if cap % n_dev or cap // n_dev == 0:
+            return None
+        from ..parallel.dist import get_sample_sort
+
+        # transformed lexsort keys (major->minor), via the same fold as
+        # K.sort_indices so the two orderings cannot diverge
+        tkeys = []
+        route = None
+        for data, valid, asc, nf in keys:
+            folded = K.fold_sort_key(data, valid, asc, nf)
+            tkeys.extend(folded)
+            if route is None:
+                # routing value: monotone in (null_rank, value) of the primary
+                # key — nulls fold to the dtype extreme so they colocate
+                d = folded[-1]
+                if valid is None:
+                    route = d
+                else:
+                    if jnp.issubdtype(d.dtype, jnp.floating):
+                        ext = jnp.asarray(-jnp.inf if nf else jnp.inf, d.dtype)
+                    else:
+                        info = jnp.iinfo(d.dtype)
+                        ext = jnp.asarray(info.min if nf else info.max, d.dtype)
+                    route = jnp.where(valid, d, ext)
+        payload = []
+        has_valid = []
+        for c in child.columns.values():
+            payload.append(c.data)
+            has_valid.append(c.valid is not None)
+        for c in child.columns.values():
+            if c.valid is not None:
+                payload.append(c.valid)
+        live = child.row_mask()
+        local_rows = cap // n_dev
+        cap_route = bucket_cap(max(1, 2 * local_rows // n_dev))
+        while True:
+            fn = get_sample_sort(mesh, len(tkeys), len(payload), cap_route)
+            out = fn(route, live, *tkeys, *payload)
+            overflow = int(out[-1])
+            if overflow == 0:
+                break
+            if cap_route >= local_rows:  # can't overflow at this cap; bug guard
+                return None
+            self.on_task_failure(
+                f"task retry: distributed sort bucket overflow "
+                f"({overflow} rows); doubling route capacity"
+            )
+            cap_route = min(cap_route * 2, local_rows)
+        cols_out = out[1:1 + len(child.columns)]
+        valids_out = list(out[1 + len(child.columns):-1])
+        cols = {}
+        vi = 0
+        for i, (name, c) in enumerate(child.columns.items()):
+            valid = None
+            if has_valid[i]:
+                valid = valids_out[vi]
+                vi += 1
+            cols[name] = Column(
+                cols_out[i], c.dtype, valid, c.dictionary, c.subset_stats()
+            )
+        return Table(cols, child.nrows)
 
     def _exec_distinct(self, node: P.Distinct) -> Table:
         child = self.execute(node.child)
@@ -474,19 +557,20 @@ class Executor:
         lh = K.hash_columns(lk, lv)
         rh = K.hash_columns(rk, rv)
 
-        def ship(table, live):
-            datas, valids = [], []
-            for c in table.columns.values():
-                datas.append(c.data)
-                valids.append(
-                    c.valid
-                    if c.valid is not None
-                    else jnp.ones(table.cap, bool)
-                )
-            return datas + valids
+        def ship(table):
+            # data buffers for every column, then ONLY the real validity
+            # masks — null-free columns don't pay for an all-True mask
+            # through the two all_to_all exchanges
+            datas = [c.data for c in table.columns.values()]
+            masks = [
+                c.valid for c in table.columns.values() if c.valid is not None
+            ]
+            return datas, masks
 
-        l_ship = ship(left, llive)
-        r_ship = ship(right, rlive)
+        l_datas, l_masks = ship(left)
+        r_datas, r_masks = ship(right)
+        l_ship = l_datas + l_masks
+        r_ship = r_datas + r_masks
         n_lc = len(l_ship)
         n_rc = len(r_ship)
         # per-(source, destination) bucket: each device's shard holds
@@ -525,13 +609,21 @@ class Executor:
         nl = len(left.columns)
         nr = len(right.columns)
         cols = {}
+        mi = nl
         for i, (name, c) in enumerate(left.columns.items()):
-            valid = l_out[nl + i] & ok
+            valid = None
+            if c.valid is not None:
+                valid = l_out[mi] & ok
+                mi += 1
             cols[name] = Column(
                 l_out[i], c.dtype, valid, c.dictionary, c.gather_stats()
             )
+        mi = nr
         for i, (name, c) in enumerate(right.columns.items()):
-            valid = r_out[nr + i] & ok
+            valid = None
+            if c.valid is not None:
+                valid = r_out[mi] & ok
+                mi += 1
             cols[name] = Column(
                 r_out[i], c.dtype, valid, c.dictionary, c.gather_stats()
             )

@@ -84,6 +84,24 @@ def mask_count(mask: jnp.ndarray) -> int:
 # ---------------------------------------------------------------------------
 
 
+def fold_sort_key(data, valid, ascending: bool, nulls_first: bool):
+    """Direction/null folding for ONE sort key: the transformed comparison
+    arrays in major->minor significance order ([null_rank, value] when the
+    key is nullable, else [value]). Shared by the single-device lexsort and
+    the distributed samplesort (exec._try_dist_sort) so the two orderings
+    can never diverge."""
+    d = data
+    if jnp.issubdtype(d.dtype, jnp.integer):
+        d = d.astype(I64)
+    if not ascending:
+        d = -d
+    if valid is None:
+        return [d]
+    null_rank = jnp.where(valid, jnp.int32(0),
+                          jnp.int32(-1 if nulls_first else 1))
+    return [null_rank, jnp.where(valid, d, jnp.zeros((), d.dtype))]
+
+
 def sort_indices(keys, live_mask: jnp.ndarray) -> jnp.ndarray:
     """Stable multi-key sort; returns row order with live rows first.
 
@@ -93,19 +111,7 @@ def sort_indices(keys, live_mask: jnp.ndarray) -> jnp.ndarray:
     """
     lex = []  # least-significant first for jnp.lexsort
     for data, valid, ascending, nulls_first in reversed(keys):
-        d = data
-        if jnp.issubdtype(d.dtype, jnp.integer):
-            d = d.astype(I64)
-        if not ascending:
-            d = -d
-        if valid is not None:
-            null_rank = jnp.where(valid, jnp.int32(0),
-                                  jnp.int32(-1 if nulls_first else 1))
-            d = jnp.where(valid, d, 0)
-            lex.append(d)
-            lex.append(null_rank)
-        else:
-            lex.append(d)
+        lex.extend(reversed(fold_sort_key(data, valid, ascending, nulls_first)))
     lex.append(~live_mask)  # most significant: dead rows last
     return jnp.lexsort(tuple(lex)).astype(jnp.int32)
 

@@ -148,6 +148,59 @@ def test_update_refresh_sets(updatedir):
     assert dele.num_rows == 3  # 3 DATE1/DATE2 tuples per refresh set
 
 
+def test_cluster_localhost_matches_local(tmp_path):
+    """Cluster fan-out over a localhost hosts file is byte-identical to
+    local generation (the shared-filesystem contract)."""
+    from nds_tpu.cli import gen_data
+
+    local = tmp_path / "local"
+    gen_data.main(["local", "--scale", SCALE, "--parallel", "2",
+                   "--data_dir", str(local)])
+    hosts = tmp_path / "hosts.txt"
+    hosts.write_text("# comment\nlocalhost\n127.0.0.1\n")
+    clus = tmp_path / "cluster"
+    gen_data.main(["cluster", "--scale", SCALE, "--parallel", "2",
+                   "--data_dir", str(clus), "--hosts", str(hosts)])
+    for table in ("store_sales", "item", "date_dim"):
+        a = sorted(os.listdir(local / table))
+        assert a == sorted(os.listdir(clus / table))
+        for f in a:
+            assert (local / table / f).read_bytes() == (clus / table / f).read_bytes()
+
+
+def test_cluster_retries_failed_chunk(tmp_path, monkeypatch):
+    """A chunk whose process dies is re-launched on the next host and the
+    run still completes; exhausting --retries raises."""
+    from nds_tpu.cli import gen_data
+
+    real_spawn = gen_data._spawn_on_host
+    first_attempt_failed = set()
+
+    def flaky(host, cmd):
+        chunk = cmd[cmd.index("-child") + 1]
+        if chunk not in first_attempt_failed:
+            first_attempt_failed.add(chunk)
+            return subprocess.Popen(["false"])
+        return real_spawn("localhost", cmd)
+
+    monkeypatch.setattr(gen_data, "_spawn_on_host", flaky)
+    hosts = tmp_path / "hosts.txt"
+    hosts.write_text("hostA\nhostB\n")  # never ssh'd: spawn is patched
+    out = tmp_path / "out"
+    gen_data.main(["cluster", "--scale", SCALE, "--parallel", "2",
+                   "--data_dir", str(out), "--hosts", str(hosts),
+                   "--table", "item"])
+    assert len(first_attempt_failed) == 2  # both chunks failed once
+    assert sorted(os.listdir(out / "item")) == ["item_1_2.dat", "item_2_2.dat"]
+
+    monkeypatch.setattr(gen_data, "_spawn_on_host",
+                        lambda host, cmd: subprocess.Popen(["false"]))
+    with pytest.raises(Exception, match="after 1 retries"):
+        gen_data.main(["cluster", "--scale", SCALE, "--parallel", "2",
+                       "--data_dir", str(tmp_path / "dead"), "--hosts", str(hosts),
+                       "--retries", "1", "--table", "item"])
+
+
 def test_range_generation(tmp_path):
     from nds_tpu.cli.gen_data import main
 

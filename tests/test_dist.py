@@ -95,3 +95,52 @@ def test_partition_exchange_detects_overflow(mesh):
     ex = partition_exchange(mesh, cap)
     _, _, dropped = jax.block_until_ready(ex(keys, vals, live))
     assert int(dropped) == n - cap * N_DEV
+
+
+def test_sample_sort_global_order(mesh):
+    from nds_tpu.parallel.dist import sample_sort
+
+    rng = np.random.default_rng(9)
+    n = 256 * N_DEV
+    shard = NamedSharding(mesh, P("data"))
+    keys = jax.device_put(
+        jnp.asarray(rng.integers(-1000, 1000, n), jnp.int64), shard)
+    vals = jax.device_put(jnp.arange(n, dtype=jnp.int64), shard)
+    live = jax.device_put(jnp.asarray(rng.random(n) < 0.9), shard)
+
+    fn = sample_sort(mesh, n_keys=1, n_cols=2, cap_route=64)
+    live_out, k_out, v_out, ov = jax.block_until_ready(
+        fn(keys, live, keys, keys, vals))
+    assert int(ov) == 0
+    k_host, v_host, l_host = (np.asarray(x) for x in (keys, vals, live))
+    L = int(l_host.sum())
+    lo, ko, vo = (np.asarray(x) for x in (live_out, k_out, v_out))
+    # live rows first (the Table layout), globally sorted
+    assert lo[:L].all() and not lo[L:].any()
+    np.testing.assert_array_equal(ko[:L], np.sort(k_host[l_host]))
+    # payload rides with its row
+    got = sorted(zip(ko[:L].tolist(), vo[:L].tolist()))
+    want = sorted(zip(k_host[l_host].tolist(), v_host[l_host].tolist()))
+    assert got == want
+
+
+def test_sample_sort_skew_overflow_and_max_cap(mesh):
+    from nds_tpu.parallel.dist import sample_sort
+
+    rng = np.random.default_rng(10)
+    n = 256 * N_DEV
+    local = n // N_DEV
+    shard = NamedSharding(mesh, P("data"))
+    # 95% of rows share one key: every one of them must land on one device
+    raw = np.where(rng.random(n) < 0.95, 7, rng.integers(-500, 500, n))
+    keys = jax.device_put(jnp.asarray(raw, jnp.int64), shard)
+    live = jax.device_put(jnp.ones(n, bool), shard)
+
+    small = sample_sort(mesh, n_keys=1, n_cols=1, cap_route=8)
+    *_, ov = jax.block_until_ready(small(keys, live, keys, keys))
+    assert int(ov) > 0  # skew detected, caller must retry
+
+    big = sample_sort(mesh, n_keys=1, n_cols=1, cap_route=local)
+    live_out, k_out, ov = jax.block_until_ready(big(keys, live, keys, keys))
+    assert int(ov) == 0  # cap == local rows can never overflow
+    np.testing.assert_array_equal(np.asarray(k_out)[: n], np.sort(raw))
