@@ -80,18 +80,27 @@ def bench_geomean(sess):
         queries = gen_sql_from_stream(os.path.join(d, "query_0.sql"))
     per_query = {}
     failed = []
-    for name, q in queries.items():
+    for i, (name, q) in enumerate(queries.items()):
         try:
+            t0 = time.perf_counter()
             warm = sess.run_script(q)  # warmup: results are lazy,
             if warm is not None:       # collect() is what compiles/executes
                 warm.collect()
+            cold = time.perf_counter() - t0
             t0 = time.perf_counter()
             r = sess.run_script(q)
             if r is not None:
                 r.collect()
             per_query[name] = time.perf_counter() - t0
-        except Exception:
+            print(
+                f"[{i + 1}/{len(queries)}] {name}: cold={cold:.1f}s "
+                f"steady={per_query[name]:.2f}s",
+                file=sys.stderr,
+            )
+        except Exception as exc:
             failed.append(name)
+            print(f"[{i + 1}/{len(queries)}] {name}: FAILED {exc}",
+                  file=sys.stderr)
     if not per_query:
         return None, 0, failed
     geo = math.exp(sum(math.log(max(t, 1e-4)) for t in per_query.values())

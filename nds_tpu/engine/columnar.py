@@ -288,10 +288,17 @@ def _infer_dtype(t: pa.DataType) -> DType:
     raise ValueError(f"unsupported arrow type {t}")
 
 
-def column_to_arrow(col: Column, nrows: int) -> pa.Array:
-    """Materialize a device column back into Arrow (collect/write path)."""
-    data = np.asarray(col.data[:nrows])
-    valid = None if col.valid is None else np.asarray(col.valid[:nrows])
+def column_to_arrow(col: Column, nrows: int, host=None) -> pa.Array:
+    """Materialize a device column back into Arrow (collect/write path).
+    `host`: optional pre-fetched (data, valid) numpy pair so callers can batch
+    the device->host transfers of many columns into one round trip."""
+    if host is not None:
+        data, valid = host
+        data = data[:nrows]
+        valid = None if valid is None else valid[:nrows]
+    else:
+        data = np.asarray(col.data[:nrows])
+        valid = None if col.valid is None else np.asarray(col.valid[:nrows])
     mask = None if valid is None else ~valid
     dt = col.dtype
     if dt.is_string:
@@ -321,7 +328,19 @@ def column_to_arrow(col: Column, nrows: int) -> pa.Array:
 
 
 def table_to_arrow(table: Table) -> pa.Table:
-    arrays = [column_to_arrow(c, table.nrows) for c in table.columns.values()]
+    # one batched device->host round trip for every buffer (each blocking
+    # np.asarray would otherwise pay a full tunnel round trip per column)
+    flat = []
+    for c in table.columns.values():
+        flat.append(c.data)
+        if c.valid is not None:
+            flat.append(c.valid)
+    fetched = iter(jax.device_get(flat))
+    arrays = []
+    for c in table.columns.values():
+        data = next(fetched)
+        valid = next(fetched) if c.valid is not None else None
+        arrays.append(column_to_arrow(c, table.nrows, host=(data, valid)))
     return pa.table(arrays, names=table.names)
 
 

@@ -276,7 +276,7 @@ class Executor:
         )
 
     def _exec_multijoin(self, node: P.MultiJoin) -> Table:
-        tables = [self.execute(r) for r in node.relations]
+        tables = self._execute_relations_batched(node.relations)
         n = len(tables)
         if n == 1:
             return tables[0]
@@ -290,6 +290,49 @@ class Executor:
             return i
 
         current = {i: tables[i] for i in range(n)}
+
+        return self._multijoin_greedy(node, tables, current, edges, merged, group, n)
+
+    def _execute_relations_batched(self, relations):
+        """Execute a MultiJoin's relations, folding the host sync of every
+        top-level Filter into ONE device->host round trip.
+
+        Eager compaction needs each filter's live count to size its output
+        bucket; executing relations one-by-one pays a full tunnel round trip
+        per filtered dimension (~70-130 ms each on a remote chip). Here all
+        predicate masks are dispatched first, their counts fetched with a
+        single batched jax.device_get, then the compactions sized and issued."""
+        deferred = []  # (slots, plan_node, child_table, mask)
+        deferred_by_id = {}  # id(node) -> deferred entry (dedupe repeats)
+        tables = []
+        for r in relations:
+            if isinstance(r, P.Filter) and id(r) not in self._cte_cache:
+                tables.append(None)
+                if id(r) in deferred_by_id:  # same Filter object repeated
+                    deferred_by_id[id(r)][0].append(len(tables) - 1)
+                    continue
+                child = self.execute(r.child)
+                mask = self._predicate_mask(child, r.predicate)
+                entry = ([len(tables) - 1], r, child, mask)
+                deferred.append(entry)
+                deferred_by_id[id(r)] = entry
+            else:
+                tables.append(self.execute(r))
+        if deferred:
+            counts = jax.device_get(
+                [jnp.sum(m) for (_, _, _, m) in deferred]
+            )
+            for (slots, r, child, mask), cnt in zip(deferred, counts):
+                cnt = int(cnt)
+                cap = bucket_cap(max(cnt, 1))
+                idx = K.compact_indices(mask, cap)
+                out = self._take(child, idx, cnt)
+                self._cte_cache[id(r)] = out  # same memoization as execute()
+                for slot in slots:
+                    tables[slot] = out
+        return tables
+
+    def _multijoin_greedy(self, node, tables, current, edges, merged, group, n):
         # greedy: repeatedly take the connecting edge whose joined inputs are
         # smallest (sum of live rows), execute that join
         while True:
@@ -970,6 +1013,18 @@ class Executor:
         if fn == "count":
             counts = K.segment_reduce(sdata, gid, weight, gcap, "count")
             return Column(counts.astype(jnp.int64), INT64)
+        if fn == "sum" and self._use_pallas_agg(c.dtype):
+            # opt-in MXU path: per-tile one-hot matmul aggregation
+            # (ops/pallas_kernels.py). float32 accumulation — enable only
+            # when the validator's relative-epsilon tolerance is acceptable.
+            from ..ops.pallas_kernels import segment_sums_pallas
+
+            pgid = jnp.where(weight, gid, -1).astype(jnp.int32)
+            s, n = segment_sums_pallas(
+                sdata.astype(jnp.float32), pgid, gcap,
+                interpret=jax.devices()[0].platform != "tpu",
+            )
+            return Column(s.astype(jnp.float64), c.dtype, n > 0)
         if fn in ("sum", "min", "max"):
             red = K.segment_reduce(sdata, gid, weight, gcap, fn)
             counts = K.segment_reduce(sdata, gid, weight, gcap, "count")
@@ -1000,6 +1055,15 @@ class Executor:
             out = jnp.sqrt(var) if fn == "stddev_samp" else var
             return Column(out, FLOAT64, n > 1)
         raise ExecError(f"aggregate {fn}")
+
+    def _use_pallas_agg(self, dtype) -> bool:
+        """engine.pallas_agg=on routes float SUMs through the Pallas MXU
+        one-hot-matmul groupby. Opt-in because accumulation is float32 (the
+        reference's --floats mode tolerance, not exact-decimal)."""
+        session = getattr(self.catalog, "session", None)
+        if session is None or session.conf.get("engine.pallas_agg") != "on":
+            return False
+        return dtype.kind == "float64"
 
     def _eval_distinct_agg(self, agg, ev, child, subset, key_cols, gcap, ngroups):
         """count(distinct x) / sum(distinct x): two-level grouping.

@@ -140,6 +140,32 @@ QUERIES = {
         select ss_store_sk, count(*) c from store_sales
         group by ss_store_sk having count(*) > 10 order by ss_store_sk
     """,
+    "window_rank": """
+        select * from (
+            select ss_store_sk, ss_item_sk, ss_quantity,
+                   rank() over (partition by ss_store_sk
+                                order by ss_quantity desc, ss_item_sk) rk
+            from store_sales where ss_store_sk is not null
+        ) w where rk <= 3 order by ss_store_sk, rk, ss_item_sk
+    """,
+    "window_running_sum": """
+        select d_year, s_state, sum(sum(ss_quantity)) over
+                   (partition by s_state order by d_year) cume
+        from store_sales, date_dim, store
+        where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+        group by d_year, s_state order by s_state, d_year
+    """,
+    "rollup_groups": """
+        select d_year, s_state, count(*) c from store_sales, date_dim, store
+        where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+        group by rollup(d_year, s_state) order by d_year, s_state
+    """,
+    "setop_except": """
+        select ss_item_sk from store_sales where ss_quantity > 50
+        except
+        select ss_item_sk from store_sales where ss_quantity <= 50
+        order by ss_item_sk
+    """,
 }
 
 
