@@ -31,7 +31,7 @@ def main(argv=None):
     )
     parser.add_argument(
         "--output_format",
-        choices=["parquet", "csv", "orc", "json", "lakehouse"],
+        choices=["parquet", "csv", "orc", "json", "avro", "lakehouse"],
         default="parquet",
         help="output data format when converting CSV data sources",
     )

@@ -814,7 +814,7 @@ class Executor:
                     data = data.astype(jnp.int32)
                 keys.append(data)
                 valids.append(c.valid)
-            order, gid, ngroups = K.group_rows(keys, valids, live)
+            order, gid, ngroups = K.group_rows(keys, valids, live, child.nrows)
         else:
             # single global group over live rows
             order = K.sort_indices([], live)
@@ -1020,8 +1020,11 @@ class Executor:
             from ..ops.pallas_kernels import segment_sums_pallas
 
             pgid = jnp.where(weight, gid, -1).astype(jnp.int32)
+            # mask dead/null lanes: a zero one-hot entry does not neutralize
+            # NaN garbage (0*NaN=NaN would poison the whole group tile)
+            pvals = jnp.where(weight, sdata, 0).astype(jnp.float32)
             s, n = segment_sums_pallas(
-                sdata.astype(jnp.float32), pgid, gcap,
+                pvals, pgid, gcap,
                 interpret=jax.devices()[0].platform != "tpu",
             )
             return Column(s.astype(jnp.float64), c.dtype, n > 0)
@@ -1085,7 +1088,7 @@ class Executor:
             keys.append(d)
             valids.append(kc.valid)
         order2, gid2, ng2 = K.group_rows(
-            keys + [c.data], valids + [c.valid], live
+            keys + [c.data], valids + [c.valid], live, child.nrows
         )
         g2cap = bucket_cap(max(ng2, 1))
         first2 = K.segment_starts(gid2, g2cap)
@@ -1096,7 +1099,7 @@ class Executor:
         if keys:
             okeys = [k[rows2] for k in keys]
             ovalids = [None if v is None else v[rows2] for v in valids]
-            order3, gid3, ng3 = K.group_rows(okeys, ovalids, live2)
+            order3, gid3, ng3 = K.group_rows(okeys, ovalids, live2, ng2)
         else:
             order3 = K.sort_indices([], live2)
             gid3 = jnp.zeros(g2cap, jnp.int32)
@@ -1468,7 +1471,7 @@ class Executor:
                 d = d.astype(jnp.int32)
             keys.append(d)
             valids.append(c.valid)
-        order, gid, ng = K.group_rows(keys, valids, t.row_mask())
+        order, gid, ng = K.group_rows(keys, valids, t.row_mask(), t.nrows)
         gcap = bucket_cap(max(ng, 1))
         first = K.segment_starts(gid, gcap)
         rows = order[jnp.clip(first, 0, t.cap - 1)]

@@ -142,13 +142,14 @@ def _group_flags(sorted_keys, sorted_valids, live_sorted):
     return flag
 
 
-def group_rows(keys, valids, live_mask):
+def group_rows(keys, valids, live_mask, nlive=None):
     """Sort rows so equal keys are adjacent and assign group ids.
 
     Returns (order, gid_sorted, ngroups): `order` the sorted row order,
     `gid_sorted[i]` the 0-based group of sorted row i, `ngroups` the number of
     live groups (host int). Nulls form their own group (Spark GROUP BY
-    semantics).
+    semantics). Pass `nlive` when the live count is already known on the host
+    (a Table's nrows) — it saves one device round trip per groupby.
     """
     sort_keys = []
     for data, valid in zip(keys, valids):
@@ -159,7 +160,8 @@ def group_rows(keys, valids, live_mask):
     live_sorted = live_mask[order]
     flags = _group_flags(sorted_keys, sorted_valids, live_sorted)
     gid = jnp.cumsum(flags.astype(jnp.int32)) - 1
-    nlive = mask_count(live_mask)
+    if nlive is None:
+        nlive = mask_count(live_mask)
     if nlive == 0:
         return order, gid, 0
     ngroups = int(gid[nlive - 1]) + 1

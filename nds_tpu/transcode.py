@@ -83,8 +83,20 @@ def transcode_table(
         else:
             LakehouseTable.create(dst, batches(), arrow_schema)
         return rows
-    if output_format not in ("parquet", "csv", "orc", "json"):
+    if output_format not in ("parquet", "csv", "orc", "json", "avro"):
         raise ValueError(f"unsupported output format {output_format}")
+
+    if output_format == "avro":
+        # container-file writer in nds_tpu/io/avro.py (reference:
+        # nds_transcode.py:241-249 offers avro through the external
+        # spark-avro plugin; here the subset of the spec NDS needs is
+        # implemented directly)
+        from .io.avro import write_avro
+
+        os.makedirs(dst, exist_ok=True)
+        write_avro(batches(), os.path.join(dst, basename.format(i=0)),
+                   schema=arrow_schema, record_name=table)
+        return rows
 
     if output_format == "json":
         # line-delimited JSON (reference: nds_transcode.py:61-144 'json'

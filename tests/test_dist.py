@@ -144,3 +144,20 @@ def test_sample_sort_skew_overflow_and_max_cap(mesh):
     live_out, k_out, ov = jax.block_until_ready(big(keys, live, keys, keys))
     assert int(ov) == 0  # cap == local rows can never overflow
     np.testing.assert_array_equal(np.asarray(k_out)[: n], np.sort(raw))
+
+
+def test_multihost_single_process_degenerates(mesh):
+    """multihost utilities: in a 1-process world initialize() is a no-op,
+    global_mesh covers the local devices, and shard_rows_across_hosts is a
+    plain row-sharded device_put (the DCN path needs a real pod)."""
+    from nds_tpu.parallel import multihost
+
+    multihost.initialize()  # no cluster env: must not raise
+    m = multihost.global_mesh()
+    assert m.devices.size == len(jax.devices())
+    rows = np.arange(16 * N_DEV, dtype=np.int64)
+    arr = multihost.shard_rows_across_hosts(mesh, rows)
+    assert arr.shape == rows.shape
+    np.testing.assert_array_equal(np.asarray(arr), rows)
+    # actually sharded: each device holds 1/N of the rows
+    assert len(arr.sharding.device_set) == N_DEV
