@@ -70,6 +70,7 @@ def bench_q3(sess, fact_rows):
 
 def bench_geomean(sess):
     """Steady-state per-query seconds over stream 0 of every template."""
+    import concurrent.futures as cf
     import tempfile
 
     from nds_tpu.datagen.query_streams import generate_streams
@@ -80,23 +81,46 @@ def bench_geomean(sess):
         queries = gen_sql_from_stream(os.path.join(d, "query_0.sql"))
     per_query = {}
     failed = []
+
+    def run_once(q):
+        r = sess.run_script(q)
+        if r is not None:
+            r.collect()
+
+    # worker-thread timeout: a wedged device runtime blocks inside native
+    # code where signals never fire; a thread join with timeout still
+    # returns control (the stuck worker is abandoned)
+    per_query_budget = int(os.environ.get("NDS_BENCH_QUERY_TIMEOUT", "900"))
+    consecutive_timeouts = 0
+    pool = cf.ThreadPoolExecutor(max_workers=1)
     for i, (name, q) in enumerate(queries.items()):
         try:
             t0 = time.perf_counter()
-            warm = sess.run_script(q)  # warmup: results are lazy,
-            if warm is not None:       # collect() is what compiles/executes
-                warm.collect()
+            pool.submit(run_once, q).result(timeout=per_query_budget)
             cold = time.perf_counter() - t0
             t0 = time.perf_counter()
-            r = sess.run_script(q)
-            if r is not None:
-                r.collect()
+            pool.submit(run_once, q).result(timeout=per_query_budget)
             per_query[name] = time.perf_counter() - t0
+            consecutive_timeouts = 0
             print(
                 f"[{i + 1}/{len(queries)}] {name}: cold={cold:.1f}s "
                 f"steady={per_query[name]:.2f}s",
                 file=sys.stderr,
             )
+        except cf.TimeoutError:
+            failed.append(name)
+            consecutive_timeouts += 1
+            print(f"[{i + 1}/{len(queries)}] {name}: TIMEOUT "
+                  f"(> {per_query_budget}s)", file=sys.stderr)
+            # the worker is stuck in a native wait; abandon the pool and
+            # start a fresh worker thread for the next query
+            pool = cf.ThreadPoolExecutor(max_workers=1)
+            if consecutive_timeouts >= 3:
+                # a wedged backend stalls every later query too; report
+                # what we have instead of burning the whole budget
+                print("3 consecutive timeouts - backend wedged; aborting "
+                      "geomean", file=sys.stderr)
+                break
         except Exception as exc:
             failed.append(name)
             print(f"[{i + 1}/{len(queries)}] {name}: FAILED {exc}",

@@ -803,6 +803,7 @@ class Executor:
             if direct is not None:
                 return direct
 
+        packed = None
         if active:
             keys = []
             valids = []
@@ -814,6 +815,9 @@ class Executor:
                     data = data.astype(jnp.int32)
                 keys.append(data)
                 valids.append(c.valid)
+            packed = self._pack_group_keys(active, live)
+            if packed is not None:
+                keys, valids = packed, [None] * len(packed)
             order, gid, ngroups = K.group_rows(keys, valids, live, child.nrows)
         else:
             # single global group over live rows
@@ -832,8 +836,68 @@ class Executor:
         live_sorted = live[order]
         return self._agg_output(
             child, key_items, key_cols, agg_items, subset,
-            order, gid, ngroups, ev, gcap, live_sorted,
+            order, gid, ngroups, ev, gcap, live_sorted, packed,
         )
+
+    # -- group-key packing -------------------------------------------------
+    # XLA TPU sort compile time explodes with comparator operand count:
+    # q4's 8-key year_total grouping (16 lexsort operands with null ranks)
+    # took >30 min to compile. Grouping only needs EQUALITY-preserving
+    # adjacency, so N integer keys pack exactly into 1-2 mixed-radix int64
+    # words (code 0 reserved per key for NULL) and the sort compiles a
+    # 2-3 operand comparator in seconds. Exact — never hash-collides.
+    _PACK_MIN_OPERANDS = 4
+    _PACK_MAX_WORDS = 3
+
+    def _pack_group_keys(self, active_cols, live):
+        operands = sum(2 if c.valid is not None else 1 for c in active_cols)
+        if operands < self._PACK_MIN_OPERANDS:
+            return None
+        datas, valids, bounds, need = [], [], [], []
+        for i, c in enumerate(active_cols):
+            if jnp.issubdtype(c.data.dtype, jnp.floating):
+                return None  # float keys: no exact integer radix
+            datas.append(c.data.astype(jnp.int64))
+            valids.append(c.valid)
+            st = c.stats
+            if st is not None and st.vmin is not None and st.vmax is not None:
+                bounds.append((int(st.vmin), int(st.vmax)))
+            else:
+                bounds.append(None)
+                need.append(i)
+        if need:
+            # one fused kernel + one host transfer for every missing range
+            import jax
+
+            fetched = jax.device_get(
+                K.batched_min_max(
+                    [datas[i] for i in need],
+                    [valids[i] for i in need],
+                    live,
+                )
+            )
+            for i, mm in zip(need, fetched):
+                bounds[i] = (int(mm[0]), int(mm[1]))
+        words, cur, bits_used = [], None, 0
+        for d, v, (vmin, vmax) in zip(datas, valids, bounds):
+            if vmax < vmin:  # all-null/empty column: single code
+                vmin, vmax = 0, 0
+                d = jnp.zeros_like(d)
+            span = vmax - vmin + 2  # +1 for the reserved NULL code 0
+            width = max(1, int(span - 1).bit_length())
+            code = d - vmin + 1
+            if v is not None:
+                code = jnp.where(v, code, 0)
+            if bits_used + width > 62:
+                words.append(cur)
+                cur, bits_used = None, 0
+            if width > 62 or len(words) >= self._PACK_MAX_WORDS:
+                return None  # absurd range: fall back to plain lexsort
+            cur = code if cur is None else (cur << width) | code
+            bits_used += width
+        if cur is not None:
+            words.append(cur)
+        return words
 
     # -- direct (sort-free) aggregation ----------------------------------
     # When the combined group-key domain is small (the TPC-DS norm), group
@@ -930,6 +994,7 @@ class Executor:
     def _agg_output(
         self, child, key_items, key_cols, agg_items, subset,
         order, gid, ngroups, ev, gcap=None, live_sorted=None,
+        packed_keys=None,
     ):
         if ngroups == 0:
             cols = {}
@@ -963,13 +1028,13 @@ class Executor:
         for agg, name in agg_items:
             cols[name] = self._eval_agg(
                 agg, ev, order, gid, gcap, live_sorted, ngroups, child, subset,
-                key_cols,
+                key_cols, packed_keys,
             )
         return Table(cols, ngroups)
 
     def _eval_agg(
         self, agg: E.Agg, ev, order, gid, gcap, live_sorted, ngroups, child,
-        subset, key_cols,
+        subset, key_cols, packed_keys=None,
     ) -> Column:
         fn = agg.fn
         if fn == "grouping":
@@ -987,7 +1052,7 @@ class Executor:
             return Column(v, DType("int32"))
         if agg.distinct:
             return self._eval_distinct_agg(
-                agg, ev, child, subset, key_cols, gcap, ngroups
+                agg, ev, child, subset, key_cols, gcap, ngroups, packed_keys
             )
         if fn == "count" and agg.arg is None:
             counts = K.segment_reduce(
@@ -1068,7 +1133,8 @@ class Executor:
             return False
         return dtype.kind == "float64"
 
-    def _eval_distinct_agg(self, agg, ev, child, subset, key_cols, gcap, ngroups):
+    def _eval_distinct_agg(self, agg, ev, child, subset, key_cols, gcap,
+                           ngroups, packed_keys=None):
         """count(distinct x) / sum(distinct x): two-level grouping.
 
         Null values of x stay live through both passes (so every outer group
@@ -1087,8 +1153,15 @@ class Executor:
                 d = d.astype(jnp.int32)
             keys.append(d)
             valids.append(kc.valid)
+        # the main pass's packed outer keys (computed once in
+        # _aggregate_once): monotone codes keep group enumeration order
+        # identical to the unpacked sort, so positions still align
+        if packed_keys is not None:
+            gkeys, gvalids = list(packed_keys), [None] * len(packed_keys)
+        else:
+            gkeys, gvalids = keys, valids
         order2, gid2, ng2 = K.group_rows(
-            keys + [c.data], valids + [c.valid], live, child.nrows
+            gkeys + [c.data], gvalids + [c.valid], live, child.nrows
         )
         g2cap = bucket_cap(max(ng2, 1))
         first2 = K.segment_starts(gid2, g2cap)
@@ -1097,8 +1170,8 @@ class Executor:
         cvalid2 = None if c.valid is None else c.valid[rows2]
         # re-group the distinct rows by the outer keys only
         if keys:
-            okeys = [k[rows2] for k in keys]
-            ovalids = [None if v is None else v[rows2] for v in valids]
+            okeys = [k[rows2] for k in gkeys]
+            ovalids = [None if v is None else v[rows2] for v in gvalids]
             order3, gid3, ng3 = K.group_rows(okeys, ovalids, live2, ng2)
         else:
             order3 = K.sort_indices([], live2)

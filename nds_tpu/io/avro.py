@@ -224,7 +224,12 @@ def read_avro(path: str):
         n = _read_zigzag(buf)
         if n == 0:
             break
-        for _ in range(abs(n)):
+        if n < 0:
+            # spec: a negative map block count is followed by the block's
+            # byte size, then |n| entries
+            _read_zigzag(buf)
+            n = -n
+        for _ in range(n):
             k = _read_bytes(buf).decode("utf-8")
             meta[k] = _read_bytes(buf)
     schema = json.loads(meta["avro.schema"].decode("utf-8"))

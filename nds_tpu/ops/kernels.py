@@ -200,6 +200,26 @@ def _extreme(dtype, is_max):
     return jnp.asarray(jnp.inf if is_max else -jnp.inf, dtype)
 
 
+def batched_min_max(datas, valids, live):
+    """Masked (min, max) of several int64 columns in one dispatch batch, so
+    the caller pays ONE device->host transfer regardless of column count.
+    Returns stacked [k, 2]; an empty/all-null column yields (0, -1) (i.e.
+    vmax < vmin) so callers can detect it."""
+    info = jnp.iinfo(I64)
+    outs = []
+    for d, v in zip(datas, valids):
+        m = live if v is None else (live & v)
+        mn = jnp.min(jnp.where(m, d, info.max))
+        mx = jnp.max(jnp.where(m, d, info.min))
+        nonempty = m.any()
+        outs.append(
+            jnp.stack(
+                [jnp.where(nonempty, mn, 0), jnp.where(nonempty, mx, -1)]
+            )
+        )
+    return jnp.stack(outs)
+
+
 # ---------------------------------------------------------------------------
 # Equi-join
 # ---------------------------------------------------------------------------

@@ -30,8 +30,16 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None):
     initialization is skipped when no cluster environment is configured."""
     import jax
 
-    if jax.process_count() > 1:
-        return  # already initialized
+    # NOTE: do not touch jax.devices()/process_count() here — any backend
+    # query initializes XLA, after which distributed.initialize() refuses to
+    # run. Detect prior initialization through the distributed client state.
+    try:
+        from jax._src import distributed as _dist
+
+        if _dist.global_state.client is not None:
+            return  # already initialized
+    except Exception:
+        pass
     if coordinator_address is not None:
         if num_processes is None or process_id is None:
             raise ValueError(
