@@ -70,7 +70,6 @@ def bench_q3(sess, fact_rows):
 
 def bench_geomean(sess):
     """Steady-state per-query seconds over stream 0 of every template."""
-    import concurrent.futures as cf
     import tempfile
 
     from nds_tpu.datagen.query_streams import generate_streams
@@ -82,44 +81,61 @@ def bench_geomean(sess):
     per_query = {}
     failed = []
 
-    def run_once(q):
-        r = sess.run_script(q)
-        if r is not None:
-            r.collect()
-
-    # worker-thread timeout: a wedged device runtime blocks inside native
-    # code where signals never fire; a thread join with timeout still
-    # returns control (the stuck worker is abandoned)
+    # daemon-thread timeout: a wedged device runtime blocks inside native
+    # code where signals never fire; joining a daemon thread with a timeout
+    # still returns control, and daemon threads don't block process exit
     per_query_budget = int(os.environ.get("NDS_BENCH_QUERY_TIMEOUT", "900"))
-    consecutive_timeouts = 0
-    pool = cf.ThreadPoolExecutor(max_workers=1)
+
+    def run_with_timeout(q, budget):
+        import threading
+
+        box = {}
+
+        def work():
+            try:
+                r = sess.run_script(q)
+                if r is not None:
+                    r.collect()
+                box["ok"] = True
+            except Exception as exc:  # surfaced to the caller
+                box["exc"] = exc
+
+        th = threading.Thread(target=work, daemon=True)
+        th.start()
+        th.join(budget)
+        if th.is_alive():
+            # grace join: distinguish slow-but-progressing from wedged; a
+            # still-stuck worker must not race the next query on the shared
+            # session, so a true wedge aborts the whole geomean
+            th.join(60)
+            return "wedged" if th.is_alive() else "timeout"
+        if "exc" in box:
+            raise box["exc"]
+        return "ok"
+
     for i, (name, q) in enumerate(queries.items()):
         try:
             t0 = time.perf_counter()
-            pool.submit(run_once, q).result(timeout=per_query_budget)
+            status = run_with_timeout(q, per_query_budget)
             cold = time.perf_counter() - t0
-            t0 = time.perf_counter()
-            pool.submit(run_once, q).result(timeout=per_query_budget)
-            per_query[name] = time.perf_counter() - t0
-            consecutive_timeouts = 0
-            print(
-                f"[{i + 1}/{len(queries)}] {name}: cold={cold:.1f}s "
-                f"steady={per_query[name]:.2f}s",
-                file=sys.stderr,
-            )
-        except cf.TimeoutError:
+            if status == "ok":
+                t0 = time.perf_counter()
+                status = run_with_timeout(q, per_query_budget)
+                per_query[name] = time.perf_counter() - t0
+            if status == "ok":
+                print(
+                    f"[{i + 1}/{len(queries)}] {name}: cold={cold:.1f}s "
+                    f"steady={per_query[name]:.2f}s",
+                    file=sys.stderr,
+                )
+                continue
             failed.append(name)
-            consecutive_timeouts += 1
+            per_query.pop(name, None)
             print(f"[{i + 1}/{len(queries)}] {name}: TIMEOUT "
                   f"(> {per_query_budget}s)", file=sys.stderr)
-            # the worker is stuck in a native wait; abandon the pool and
-            # start a fresh worker thread for the next query
-            pool = cf.ThreadPoolExecutor(max_workers=1)
-            if consecutive_timeouts >= 3:
-                # a wedged backend stalls every later query too; report
-                # what we have instead of burning the whole budget
-                print("3 consecutive timeouts - backend wedged; aborting "
-                      "geomean", file=sys.stderr)
+            if status == "wedged":
+                print("worker still stuck after grace join - backend "
+                      "wedged; aborting geomean", file=sys.stderr)
                 break
         except Exception as exc:
             failed.append(name)

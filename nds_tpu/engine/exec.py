@@ -36,6 +36,57 @@ class ExecError(Exception):
     pass
 
 
+def _resolve_bounds(datas, valids, stats_list, wanted, live):
+    """(vmin, vmax) per column: from cached ColStats when present, else one
+    batched min/max kernel + a single device->host transfer for ALL missing
+    ranges. `wanted[i]=False` slots return None. Shared by the group-key and
+    sort-key packers."""
+    bounds, need = [], []
+    for i, (st, w) in enumerate(zip(stats_list, wanted)):
+        if not w:
+            bounds.append(None)
+            continue
+        if st is not None and st.vmin is not None and st.vmax is not None:
+            bounds.append((int(st.vmin), int(st.vmax)))
+        else:
+            bounds.append(None)
+            need.append(i)
+    if need:
+        fetched = jax.device_get(
+            K.batched_min_max(
+                [datas[i].astype(jnp.int64) for i in need],
+                [valids[i] for i in need],
+                live,
+            )
+        )
+        for i, mm in zip(need, fetched):
+            bounds[i] = (int(mm[0]), int(mm[1]))
+    return bounds
+
+
+class _WordPacker:
+    """Accumulates (code, width) fields into <=62-bit int64 words,
+    emitting each completed word through `emit`. Field order = bit
+    significance order, so lexicographic word compare == field compare."""
+
+    def __init__(self, emit):
+        self._emit = emit
+        self._cur = None
+        self._bits = 0
+
+    def add(self, code, width):
+        if self._bits + width > 62:
+            self.flush()
+        self._cur = code if self._cur is None else (self._cur << width) | code
+        self._bits += width
+
+    def flush(self):
+        if self._cur is not None:
+            self._emit(self._cur)
+        self._cur = None
+        self._bits = 0
+
+
 class Executor:
     def __init__(self, catalog, on_task_failure=None):
         """catalog: object with .load(table_name) -> Table.
@@ -112,8 +163,10 @@ class Executor:
             return child
         ev = self._evaluator(child)
         keys = []
+        cols = []
         for e, asc, nf in node.keys:
             col = ev.eval(e)
+            cols.append(col)
             data = col.data
             if col.dtype.is_string:
                 data, _ = sort_dictionary(col)
@@ -122,11 +175,67 @@ class Executor:
             if nf is None:
                 nf = asc  # Spark: NULLS FIRST for ASC, NULLS LAST for DESC
             keys.append((data, col.valid, asc, nf))
+        keys = self._pack_sort_keys(keys, cols)
         dist = self._try_dist_sort(child, keys)
         if dist is not None:
             return dist
         order = K.sort_indices(keys, child.row_mask())
         return self._take(child, order, child.nrows)
+
+    # -- sort-key packing --------------------------------------------------
+    # Same XLA-sort-comparator problem as _pack_group_keys, but ORDER BY
+    # must preserve the full lexicographic order: runs of consecutive
+    # INTEGER keys pack into mixed-radix words with direction and null
+    # position folded into the code (asc: v-vmin+1, desc: vmax-v+1; null
+    # first -> 0, null last -> span-1), floats stay standalone operands in
+    # their original position. Exact — codes are monotone per key.
+    _SORT_PACK_MIN_OPERANDS = 4
+
+    def _pack_sort_keys(self, keys, cols):
+        operands = sum(2 if v is not None else 1 for _, v, _, _ in keys)
+        if operands < self._SORT_PACK_MIN_OPERANDS:
+            return keys
+        # plan: which keys are packable ints (need stats or one batched fetch)
+        packable = [
+            not jnp.issubdtype(d.dtype, jnp.floating) for d, _, _, _ in keys
+        ]
+        # packing pays off only if some run of >=2 consecutive ints exists
+        has_run = any(
+            packable[i] and packable[i + 1] for i in range(len(keys) - 1)
+        )
+        if not has_run:
+            return keys
+        live_mask = jnp.ones(keys[0][0].shape[0], bool)
+        bounds = _resolve_bounds(
+            [k[0] for k in keys],
+            [k[1] for k in keys],
+            [c.stats if c is not None else None for c in cols],
+            packable,
+            live_mask,
+        )
+        out = []
+        packer = _WordPacker(lambda w: out.append((w, None, True, True)))
+        for (d, v, asc, nf), pk, b in zip(keys, packable, bounds):
+            if not pk:
+                packer.flush()
+                out.append((d, v, asc, nf))
+                continue
+            vmin, vmax = b
+            if vmax < vmin:  # empty/all-null: constant key, skip entirely
+                continue
+            span = vmax - vmin + 3  # codes 1..span-2; 0 and span-1 for NULL
+            width = max(1, int(span - 1).bit_length())
+            if width > 62:
+                packer.flush()
+                out.append((d, v, asc, nf))
+                continue
+            d64 = d.astype(jnp.int64)
+            code = (d64 - vmin + 1) if asc else (vmax - d64 + 1)
+            if v is not None:
+                code = jnp.where(v, code, 0 if nf else span - 1)
+            packer.add(code, width)
+        packer.flush()
+        return out
 
     # -- distributed sort -------------------------------------------------
     # ORDER BY over a mesh-sharded table: range-partitioned samplesort +
@@ -853,32 +962,18 @@ class Executor:
         operands = sum(2 if c.valid is not None else 1 for c in active_cols)
         if operands < self._PACK_MIN_OPERANDS:
             return None
-        datas, valids, bounds, need = [], [], [], []
-        for i, c in enumerate(active_cols):
+        datas, valids = [], []
+        for c in active_cols:
             if jnp.issubdtype(c.data.dtype, jnp.floating):
                 return None  # float keys: no exact integer radix
             datas.append(c.data.astype(jnp.int64))
             valids.append(c.valid)
-            st = c.stats
-            if st is not None and st.vmin is not None and st.vmax is not None:
-                bounds.append((int(st.vmin), int(st.vmax)))
-            else:
-                bounds.append(None)
-                need.append(i)
-        if need:
-            # one fused kernel + one host transfer for every missing range
-            import jax
-
-            fetched = jax.device_get(
-                K.batched_min_max(
-                    [datas[i] for i in need],
-                    [valids[i] for i in need],
-                    live,
-                )
-            )
-            for i, mm in zip(need, fetched):
-                bounds[i] = (int(mm[0]), int(mm[1]))
-        words, cur, bits_used = [], None, 0
+        bounds = _resolve_bounds(
+            datas, valids, [c.stats for c in active_cols],
+            [True] * len(datas), live,
+        )
+        words = []
+        packer = _WordPacker(words.append)
         for d, v, (vmin, vmax) in zip(datas, valids, bounds):
             if vmax < vmin:  # all-null/empty column: single code
                 vmin, vmax = 0, 0
@@ -888,15 +983,14 @@ class Executor:
             code = d - vmin + 1
             if v is not None:
                 code = jnp.where(v, code, 0)
-            if bits_used + width > 62:
-                words.append(cur)
-                cur, bits_used = None, 0
-            if width > 62 or len(words) >= self._PACK_MAX_WORDS:
+            if width > 62:
                 return None  # absurd range: fall back to plain lexsort
-            cur = code if cur is None else (cur << width) | code
-            bits_used += width
-        if cur is not None:
-            words.append(cur)
+            packer.add(code, width)
+            if len(words) >= self._PACK_MAX_WORDS:
+                return None
+        packer.flush()
+        if len(words) > self._PACK_MAX_WORDS:
+            return None
         return words
 
     # -- direct (sort-free) aggregation ----------------------------------

@@ -45,11 +45,17 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None):
             raise ValueError(
                 "coordinator_address requires num_processes and process_id"
             )
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        except RuntimeError as exc:
+            # keep the documented idempotency even if the private
+            # global_state probe above stops working on a future jax
+            if "already" not in str(exc).lower():
+                raise
         return
     if num_processes is not None or process_id is not None:
         raise ValueError(
