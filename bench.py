@@ -85,6 +85,7 @@ def bench_geomean(sess):
     # code where signals never fire; joining a daemon thread with a timeout
     # still returns control, and daemon threads don't block process exit
     per_query_budget = int(os.environ.get("NDS_BENCH_QUERY_TIMEOUT", "900"))
+    consecutive_timeouts = 0
 
     def run_with_timeout(q, budget):
         import threading
@@ -108,10 +109,11 @@ def bench_geomean(sess):
             # still-stuck worker must not race the next query on the shared
             # session, so a true wedge aborts the whole geomean
             th.join(60)
-            return "wedged" if th.is_alive() else "timeout"
-        if "exc" in box:
+            if th.is_alive():
+                return "wedged"
+        if "exc" in box:  # real failures beat the timeout label
             raise box["exc"]
-        return "ok"
+        return "ok" if "ok" in box else "timeout"
 
     for i, (name, q) in enumerate(queries.items()):
         try:
@@ -123,6 +125,7 @@ def bench_geomean(sess):
                 status = run_with_timeout(q, per_query_budget)
                 per_query[name] = time.perf_counter() - t0
             if status == "ok":
+                consecutive_timeouts = 0
                 print(
                     f"[{i + 1}/{len(queries)}] {name}: cold={cold:.1f}s "
                     f"steady={per_query[name]:.2f}s",
@@ -131,11 +134,17 @@ def bench_geomean(sess):
                 continue
             failed.append(name)
             per_query.pop(name, None)
+            consecutive_timeouts += 1
             print(f"[{i + 1}/{len(queries)}] {name}: TIMEOUT "
                   f"(> {per_query_budget}s)", file=sys.stderr)
             if status == "wedged":
                 print("worker still stuck after grace join - backend "
                       "wedged; aborting geomean", file=sys.stderr)
+                break
+            if consecutive_timeouts >= 3:
+                # uniformly slow backend: don't burn ~99 x budget seconds
+                print("3 consecutive timeouts - aborting geomean",
+                      file=sys.stderr)
                 break
         except Exception as exc:
             failed.append(name)

@@ -175,7 +175,7 @@ class Executor:
             if nf is None:
                 nf = asc  # Spark: NULLS FIRST for ASC, NULLS LAST for DESC
             keys.append((data, col.valid, asc, nf))
-        keys = self._pack_sort_keys(keys, cols)
+        keys = self._pack_sort_keys(keys, cols, child.row_mask())
         dist = self._try_dist_sort(child, keys)
         if dist is not None:
             return dist
@@ -191,7 +191,7 @@ class Executor:
     # their original position. Exact — codes are monotone per key.
     _SORT_PACK_MIN_OPERANDS = 4
 
-    def _pack_sort_keys(self, keys, cols):
+    def _pack_sort_keys(self, keys, cols, live):
         operands = sum(2 if v is not None else 1 for _, v, _, _ in keys)
         if operands < self._SORT_PACK_MIN_OPERANDS:
             return keys
@@ -205,13 +205,12 @@ class Executor:
         )
         if not has_run:
             return keys
-        live_mask = jnp.ones(keys[0][0].shape[0], bool)
         bounds = _resolve_bounds(
             [k[0] for k in keys],
             [k[1] for k in keys],
             [c.stats if c is not None else None for c in cols],
             packable,
-            live_mask,
+            live,  # dead/padded rows must not widen the spans
         )
         out = []
         packer = _WordPacker(lambda w: out.append((w, None, True, True)))
@@ -1597,12 +1596,13 @@ class Executor:
             if t.nrows == 0:
                 self._scalar_cache[key] = (None, col.dtype, col.dictionary)
             else:
-                v = np.asarray(col.data[:1])[0]
-                valid = (
-                    True
-                    if col.valid is None
-                    else bool(np.asarray(col.valid[:1])[0])
-                )
+                # one batched transfer for value + validity (vs two RTTs)
+                fetch = [col.data[:1]]
+                if col.valid is not None:
+                    fetch.append(col.valid[:1])
+                got = jax.device_get(fetch)
+                v = got[0][0]
+                valid = True if col.valid is None else bool(got[1][0])
                 self._scalar_cache[key] = (
                     v if valid else None,
                     col.dtype,

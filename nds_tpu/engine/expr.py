@@ -29,6 +29,24 @@ from .columnar import Column, Table, sort_dictionary
 _EPOCH = datetime.date(1970, 1, 1)
 
 
+def _civil_from_days(days):
+    """Vectorized days-since-epoch -> (year, month, day) on device
+    (Hinnant's civil calendar algorithm: pure integer floor arithmetic, so
+    the date split runs as one fused XLA kernel instead of a host
+    round-trip of the whole column)."""
+    z = days.astype(jnp.int64) + 719468
+    era = z // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = jnp.where(m <= 2, y + 1, y)
+    return y, m, d
+
+
 def date_to_days(s: str) -> int:
     y, m, d = s.split("-")
     return (datetime.date(int(y), int(m), int(d)) - _EPOCH).days
@@ -607,15 +625,9 @@ class Evaluator:
             return self._string_transform(e.args[0], pc.utf8_trim_whitespace)
         if name in ("year", "month", "day"):
             a = self.eval(e.args[0])
-            days = np.asarray(a.data)  # host transform: calendar math
-            dates = (np.datetime64("1970-01-01") + days.astype("timedelta64[D]"))
-            if name == "year":
-                out = dates.astype("datetime64[Y]").astype(int) + 1970
-            elif name == "month":
-                out = dates.astype("datetime64[M]").astype(int) % 12 + 1
-            else:
-                out = (dates - dates.astype("datetime64[M]")).astype(int) + 1
-            return Column(jnp.asarray(out.astype(np.int32)), INT32, a.valid)
+            y, m, d = _civil_from_days(a.data)
+            out = y if name == "year" else (m if name == "month" else d)
+            return Column(out.astype(jnp.int32), INT32, a.valid)
         if name == "date_add":
             a = self.eval(e.args[0])
             b = self.eval(e.args[1])

@@ -161,3 +161,20 @@ def test_round_decimal(t):
 def test_year(t):
     out = E.Evaluator(t).eval(E.Func("year", (E.Col("dt"),)))
     assert _vals(out, t) == [1970] * 5
+
+
+def test_civil_from_days_matches_numpy():
+    """Device-side calendar split must agree with numpy datetime64 across
+    four centuries (leap rules included)."""
+    import jax.numpy as jnp
+    from nds_tpu.engine.expr import _civil_from_days
+
+    days = np.arange(-80000, 80000, 7, dtype=np.int64)
+    y, m, d = _civil_from_days(jnp.asarray(days))
+    dates = np.datetime64("1970-01-01") + days.astype("timedelta64[D]")
+    np.testing.assert_array_equal(
+        np.asarray(y), dates.astype("datetime64[Y]").astype(int) + 1970)
+    np.testing.assert_array_equal(
+        np.asarray(m), dates.astype("datetime64[M]").astype(int) % 12 + 1)
+    np.testing.assert_array_equal(
+        np.asarray(d), (dates - dates.astype("datetime64[M]")).astype(int) + 1)
