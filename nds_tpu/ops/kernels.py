@@ -109,6 +109,20 @@ def sort_indices(keys, live_mask: jnp.ndarray) -> jnp.ndarray:
     nulls_first:bool) in major-to-minor significance order. Null ordering and
     direction are folded into a (null_rank, value) key pair per column.
     """
+    if len(keys) == 1 and keys[0][1] is None and jnp.issubdtype(
+        keys[0][0].dtype, jnp.integer
+    ):
+        # single non-null integer key (the packed-word norm): fold the
+        # dead-tail into the key value and run a one-operand stable sort —
+        # stability keeps live rows ahead of dead ones on ties, and XLA
+        # compiles a 1-operand comparator instead of 2
+        data, _, ascending, _ = keys[0]
+        d = data.astype(I64)
+        if not ascending:
+            d = -d
+        big = jnp.iinfo(I64).max
+        masked = jnp.where(live_mask, d, big)
+        return jnp.argsort(masked, stable=True).astype(jnp.int32)
     lex = []  # least-significant first for jnp.lexsort
     for data, valid, ascending, nulls_first in reversed(keys):
         lex.extend(reversed(fold_sort_key(data, valid, ascending, nulls_first)))

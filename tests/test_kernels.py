@@ -218,3 +218,21 @@ class TestWindow:
         gid = jnp.asarray(np.array([0, 0, 1, 1, 1, 2], np.int32))
         s = np.asarray(K.segment_starts(gid, 4))
         np.testing.assert_array_equal(s[:3], [0, 2, 5])
+
+
+def test_sort_indices_single_key_max_value_ties_with_dead_tail():
+    """The one-operand fast path folds dead rows to int64 max; stability
+    must keep a LIVE max-valued row ahead of the dead tail."""
+    import jax.numpy as jnp
+    from nds_tpu.ops import kernels as K
+
+    big = np.iinfo(np.int64).max
+    data = jnp.asarray([5, big, 1, 777, 888], dtype=jnp.int64)  # idx 3,4 dead
+    live = jnp.asarray([True, True, True, False, False])
+    order = np.asarray(K.sort_indices([(data, None, True, True)], live))
+    assert order.tolist()[:3] == [2, 0, 1]  # live sorted; big stays live-first
+    assert set(order.tolist()[3:]) == {3, 4}
+
+    # descending single key
+    order = np.asarray(K.sort_indices([(data, None, False, True)], live))
+    assert order.tolist()[:3] == [1, 0, 2]
