@@ -1,23 +1,35 @@
-"""Benchmark entry point for the driver: ONE JSON line on stdout.
+"""Benchmark entry point for the driver: JSON result lines on stdout.
 
-Two measurements on the real chip, through the full SQL engine
-(parse/bind/execute on device) over generated SF>=1 data:
+Measurements on the real chip, through the full SQL engine (parse/bind/
+execute on device) over generated SF>=1 data:
 
   1. q3 hot path (scan -> star-join -> group-aggregate -> sort): fact rows
      processed per second per chip, steady-state (post-compile). This is the
      headline metric; vs_baseline compares against the best previously
      recorded round (BENCH_r01.json = 174,607 rows/s), so regressions are
      visible instead of hard-coded away.
-  2. Power-Run geomean: geometric mean of per-query seconds over stream 0 of
+  2. Transcode (Load Test) rows/s: SF1 raw CSV -> parquet conversion rate
+     (reference metric shape: nds/nds_transcode.py:174-205; BASELINE.md
+     milestone #2).
+  3. Power-Run geomean: geometric mean of per-query seconds over stream 0 of
      ALL executable templates at this scale, steady-state (reference metric
      shape: nds/nds_power.py:246-281; the TPC-DS north star in BASELINE.md).
 
-Env knobs: NDS_BENCH_SCALE (default 1), NDS_BENCH_DATA, NDS_BENCH_SKIP_GEOMEAN.
+Fail-soft contract: a complete JSON result line is (re)printed after the q3
+measurement, after the transcode measurement, and after EVERY geomean query —
+each line strictly supersedes the previous one, so the driver's `tail -1`
+parse always sees the most complete results even if the process is killed
+mid-run (the round-3 rc=124 timeout recorded nothing because the single
+print sat at the very end).
+
+Env knobs: NDS_BENCH_SCALE (default 1), NDS_BENCH_DATA,
+NDS_BENCH_SKIP_GEOMEAN, NDS_BENCH_SKIP_TRANSCODE, NDS_BENCH_QUERY_TIMEOUT.
 """
 
 import json
 import math
 import os
+import signal
 import statistics
 import subprocess
 import sys
@@ -37,6 +49,36 @@ group by d.d_year, i.i_brand, i.i_brand_id
 order by d.d_year, sum_agg desc, brand_id
 limit 100
 """
+
+# the one result object, mutated in place and re-printed monotonically
+OUT = {
+    "metric": "nds_q3_fact_rows_per_sec_per_chip",
+    "value": None,
+    "unit": "rows/s",
+    "vs_baseline": None,
+    "scale_factor": SCALE,
+}
+
+
+def emit():
+    """Print the current result as one complete JSON line (fail-soft)."""
+    print(json.dumps(OUT), flush=True)
+
+
+def _on_term(signum, frame):
+    # the driver's timeout sends SIGTERM before SIGKILL. Every OUT mutation
+    # is already followed by emit(), so the last stdout line is current;
+    # buffered print/emit here could hit a reentrant-call RuntimeError if
+    # the signal lands mid-print (and that error would be swallowed by the
+    # geomean loop's except). Raw writes + immediate exit only.
+    try:
+        # leading newline terminates any half-flushed buffered line so the
+        # final line on stdout is always a complete JSON object
+        os.write(1, ("\n" + json.dumps(OUT) + "\n").encode())
+        os.write(2, b"SIGTERM: flushed partial results\n")
+    except OSError:
+        pass
+    os._exit(0)
 
 
 def ensure_data():
@@ -68,8 +110,36 @@ def bench_q3(sess, fact_rows):
     return fact_rows / statistics.median(times)
 
 
+def bench_transcode():
+    """SF1 CSV -> parquet transcode rate (rows/s), one fact + one dim table
+    (bounded time; whole-warehouse rate extrapolates linearly since the
+    reader streams fixed-size morsels)."""
+    import shutil
+    import tempfile
+
+    from nds_tpu.schema import get_schemas
+    from nds_tpu.transcode import transcode_table
+
+    schemas = get_schemas()
+    tables = ["store_returns", "customer"]
+    out = tempfile.mkdtemp(prefix="nds_transcode_bench_")
+    rows = 0
+    try:
+        t0 = time.perf_counter()
+        for t in tables:
+            rows += transcode_table(
+                DATA_DIR, out, t, schemas[t], output_format="parquet",
+                output_mode="overwrite",
+            )
+        dt = time.perf_counter() - t0
+    finally:
+        shutil.rmtree(out, ignore_errors=True)
+    return rows / dt
+
+
 def bench_geomean(sess):
-    """Steady-state per-query seconds over stream 0 of every template."""
+    """Steady-state per-query seconds over stream 0 of every template.
+    Updates OUT and re-emits after every query (fail-soft)."""
     import tempfile
 
     from nds_tpu.datagen.query_streams import generate_streams
@@ -85,7 +155,6 @@ def bench_geomean(sess):
     # code where signals never fire; joining a daemon thread with a timeout
     # still returns control, and daemon threads don't block process exit
     per_query_budget = int(os.environ.get("NDS_BENCH_QUERY_TIMEOUT", "900"))
-    consecutive_timeouts = 0
 
     def run_with_timeout(q, budget):
         import threading
@@ -104,6 +173,7 @@ def bench_geomean(sess):
         th = threading.Thread(target=work, daemon=True)
         th.start()
         th.join(budget)
+        finished_late = False
         if th.is_alive():
             # grace join: distinguish slow-but-progressing from wedged; a
             # still-stuck worker must not race the next query on the shared
@@ -111,9 +181,26 @@ def bench_geomean(sess):
             th.join(60)
             if th.is_alive():
                 return "wedged"
+            finished_late = True
         if "exc" in box:  # real failures beat the timeout label
             raise box["exc"]
-        return "ok" if "ok" in box else "timeout"
+        if "ok" in box:
+            # a query that only finished during the grace join still blew
+            # its budget: record it as a timeout, not a success
+            return "timeout" if finished_late else "ok"
+        return "timeout"
+
+    def update_out():
+        if per_query:
+            geo = math.exp(
+                sum(math.log(max(t, 1e-4)) for t in per_query.values())
+                / len(per_query)
+            )
+            OUT["geomean_query_sec"] = round(geo, 4)
+        OUT["geomean_queries"] = len(per_query)
+        if failed:
+            OUT["failed_queries"] = list(failed)
+        emit()
 
     for i, (name, q) in enumerate(queries.items()):
         try:
@@ -125,39 +212,31 @@ def bench_geomean(sess):
                 status = run_with_timeout(q, per_query_budget)
                 per_query[name] = time.perf_counter() - t0
             if status == "ok":
-                consecutive_timeouts = 0
                 print(
                     f"[{i + 1}/{len(queries)}] {name}: cold={cold:.1f}s "
                     f"steady={per_query[name]:.2f}s",
                     file=sys.stderr,
                 )
+                update_out()
                 continue
             failed.append(name)
             per_query.pop(name, None)
-            consecutive_timeouts += 1
             print(f"[{i + 1}/{len(queries)}] {name}: TIMEOUT "
                   f"(> {per_query_budget}s)", file=sys.stderr)
+            update_out()
             if status == "wedged":
                 print("worker still stuck after grace join - backend "
                       "wedged; aborting geomean", file=sys.stderr)
-                break
-            if consecutive_timeouts >= 3:
-                # uniformly slow backend: don't burn ~99 x budget seconds
-                print("3 consecutive timeouts - aborting geomean",
-                      file=sys.stderr)
                 break
         except Exception as exc:
             failed.append(name)
             print(f"[{i + 1}/{len(queries)}] {name}: FAILED {exc}",
                   file=sys.stderr)
-    if not per_query:
-        return None, 0, failed
-    geo = math.exp(sum(math.log(max(t, 1e-4)) for t in per_query.values())
-                   / len(per_query))
-    return geo, len(per_query), failed
+            update_out()
 
 
 def main():
+    signal.signal(signal.SIGTERM, _on_term)
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     ensure_data()
 
@@ -173,20 +252,22 @@ def main():
     fact_rows = sess.catalog.load("store_sales").nrows
 
     rows_per_sec = bench_q3(sess, fact_rows)
-    out = {
-        "metric": "nds_q3_fact_rows_per_sec_per_chip",
-        "value": round(rows_per_sec),
-        "unit": "rows/s",
-        "vs_baseline": round(rows_per_sec / RECORDED_BASELINE_ROWS_PER_SEC, 3),
-        "scale_factor": SCALE,
-    }
+    OUT["value"] = round(rows_per_sec)
+    OUT["vs_baseline"] = round(
+        rows_per_sec / RECORDED_BASELINE_ROWS_PER_SEC, 3
+    )
+    emit()  # q3 headline lands no matter what happens later
+
+    if not os.environ.get("NDS_BENCH_SKIP_TRANSCODE"):
+        try:
+            OUT["transcode_rows_per_sec"] = round(bench_transcode())
+        except Exception as exc:
+            print(f"transcode bench failed: {exc}", file=sys.stderr)
+        emit()
+
     if not os.environ.get("NDS_BENCH_SKIP_GEOMEAN"):
-        geo, nq, failed = bench_geomean(sess)
-        out["geomean_query_sec"] = None if geo is None else round(geo, 4)
-        out["geomean_queries"] = nq
-        if failed:
-            out["failed_queries"] = failed
-    print(json.dumps(out))
+        bench_geomean(sess)
+    emit()
 
 
 if __name__ == "__main__":

@@ -243,6 +243,10 @@ class Executor:
     _DIST_SORT_MIN_ROWS = 1 << 18
 
     def _try_dist_sort(self, child: Table, keys):
+        if not keys:
+            # every sort key was dropped by the packer (all-null/empty with
+            # no stats): nothing to route on, use the local sort path
+            return None
         session = getattr(self.catalog, "session", None)
         mesh = getattr(session, "mesh", None)
         if mesh is None:

@@ -37,11 +37,15 @@ def _enable_persistent_compile_cache():
     if _PERSISTENT_CACHE_SET:
         return
     _PERSISTENT_CACHE_SET = True
-    # uid-suffixed default: a shared world-writable dir would let another
-    # user pre-plant compiled executables and breaks on mixed ownership
-    cache_dir = os.environ.get(
-        "NDS_XLA_CACHE_DIR", f"/tmp/nds_xla_cache_{os.getuid()}"
+    # user-owned default (XDG): a /tmp default could be pre-created by any
+    # other local user (/tmp squatting), putting cache entries in an
+    # attacker-owned directory
+    default_dir = os.path.join(
+        os.environ.get("XDG_CACHE_HOME")
+        or os.path.join(os.path.expanduser("~"), ".cache"),
+        "nds_xla",
     )
+    cache_dir = os.environ.get("NDS_XLA_CACHE_DIR", default_dir)
     if not cache_dir or cache_dir == "0":
         return
     try:

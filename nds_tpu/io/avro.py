@@ -80,8 +80,13 @@ def _decimal_bytes(unscaled: int) -> bytes:
 
 def _avro_field_type(f: pa.Field):
     t = f.type
-    if pa.types.is_int64(t) or pa.types.is_int32(t):
+    if pa.types.is_int64(t):
         base = "long"
+    elif pa.types.is_int32(t):
+        # spark-avro maps IntegerType to avro "int"; keep schema parity so
+        # downstream consumers see 32-bit fields (reference: transcode avro
+        # output consumed by nds_validate)
+        base = "int"
     elif pa.types.is_floating(t):
         base = "double"
     elif pa.types.is_boolean(t):

@@ -54,7 +54,13 @@ def _seg_kernel(group_tile: int, vals_ref, gid_ref, out_ref):
             jnp.zeros((6, t), jnp.float32),
         ]
     )
-    out_ref[:] += jnp.dot(left, onehot, preferred_element_type=jnp.float32)
+    # HIGHEST precision: the TPU MXU default multiplies f32 via bf16 passes
+    # (~8 mantissa bits), which would break the "exact for measures with
+    # <= 24 significant bits" contract; full-precision f32 passes keep it
+    out_ref[:] += jnp.dot(
+        left, onehot, preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("n_groups", "interpret"))
