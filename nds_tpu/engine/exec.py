@@ -64,6 +64,19 @@ def _resolve_bounds(datas, valids, stats_list, wanted, live):
     return bounds
 
 
+class _DictStats:
+    """Static bounds facade for dictionary-coded columns (codes/ranks span
+    [0, len(dictionary)) by construction — no device fetch needed)."""
+
+    __slots__ = ("vmin", "vmax", "unique", "base_rows")
+
+    def __init__(self, vmin, vmax):
+        self.vmin = vmin
+        self.vmax = vmax
+        self.unique = False
+        self.base_rows = 0
+
+
 class _WordPacker:
     """Accumulates (code, width) fields into <=62-bit int64 words,
     emitting each completed word through `emit`. Field order = bit
@@ -77,6 +90,11 @@ class _WordPacker:
     def add(self, code, width):
         if self._bits + width > 62:
             self.flush()
+        # clamp to the field width: dead/padded rows carry arbitrary values
+        # whose codes can be negative or oversized, and an out-of-range code
+        # would corrupt the whole OR-merged word (live-row codes are always
+        # in range by construction, so this is the identity for them)
+        code = code & ((1 << width) - 1)
         self._cur = code if self._cur is None else (self._cur << width) | code
         self._bits += width
 
@@ -99,15 +117,47 @@ class Executor:
         self.on_task_failure = on_task_failure or (lambda reason: None)
         self._cte_cache = {}  # id(plan) -> Table
         self._scalar_cache = {}  # id(plan) -> python value
+        self._fp_cache = {}  # id(plan) -> structural fingerprint
+
+    # plan-node types worth caching across statements: the expensive
+    # pipeline breakers (a CTE body virtually always ends in one)
+    _CACHEABLE = (P.Aggregate, P.Distinct, P.SetOp, P.Window)
+
+    def _session_cache(self):
+        session = getattr(self.catalog, "session", None)
+        if session is None:
+            return None
+        if session.conf.get("engine.plan_cache", "on") == "off":
+            return None
+        return session.plan_cache
+
+    def _fp(self, node) -> str:
+        key = id(node)
+        fp = self._fp_cache.get(key)
+        if fp is None:
+            fp = self._fp_cache[key] = P.fingerprint(node)
+        return fp
 
     # ------------------------------------------------------------------
     def execute(self, node: P.PlanNode) -> Table:
         key = id(node)
         if key in self._cte_cache:
             return self._cte_cache[key]
+        cache = (
+            self._session_cache()
+            if isinstance(node, self._CACHEABLE)
+            else None
+        )
+        if cache is not None:
+            hit = cache.get(self._fp(node))
+            if hit is not None:
+                self._cte_cache[key] = hit
+                return hit
         m = getattr(self, f"_exec_{type(node).__name__.lower()}")
         out = m(node)
         self._cte_cache[key] = out
+        if cache is not None:
+            cache.put(self._fp(node), out)
         return out
 
     def to_arrow(self, node: P.PlanNode) -> pa.Table:
@@ -175,66 +225,128 @@ class Executor:
             if nf is None:
                 nf = asc  # Spark: NULLS FIRST for ASC, NULLS LAST for DESC
             keys.append((data, col.valid, asc, nf))
-        keys = self._pack_sort_keys(keys, cols, child.row_mask())
-        dist = self._try_dist_sort(child, keys)
+        words = self._sort_words(keys, cols, child.row_mask())
+        dist = self._try_dist_sort(
+            child, [(w, None, True, True) for w in words]
+        )
         if dist is not None:
             return dist
-        order = K.sort_indices(keys, child.row_mask())
+        order = K.sort_by_words(words)
         return self._take(child, order, child.nrows)
 
-    # -- sort-key packing --------------------------------------------------
-    # Same XLA-sort-comparator problem as _pack_group_keys, but ORDER BY
-    # must preserve the full lexicographic order: runs of consecutive
-    # INTEGER keys pack into mixed-radix words with direction and null
-    # position folded into the code (asc: v-vmin+1, desc: vmax-v+1; null
-    # first -> 0, null last -> span-1), floats stay standalone operands in
-    # their original position. Exact — codes are monotone per key.
-    _SORT_PACK_MIN_OPERANDS = 4
+    # -- sort-key word encoding -------------------------------------------
+    # Every ordering in the engine (ORDER BY, group-by adjacency, window
+    # partition sort) is encoded into int64 *words*, most significant
+    # first, and sorted by stable LSD passes over the ONE canonical kv-sort
+    # kernel per input cap (K.sort_by_words). XLA:TPU sort compiles cost
+    # ~10-12 s per comparator operand at fact shapes, so per-query
+    # comparator kernels were the dominant cold-start cost (q34's 3-operand
+    # lexsort at 4M rows alone compiled for 102 s).
+    #
+    # Encoding per key, in significance order: integer-like keys with a
+    # known span pack as mixed-radix fields (asc: v-vmin+1, desc: vmax-v+1;
+    # null first -> 0, null last -> span-1) into shared <=62-bit words;
+    # floats and huge-span ints emit a 1-bit null-rank field into the
+    # shared stream plus one standalone full-width word (floats via the
+    # order-preserving bit transform, descending via bitwise not). A
+    # leading 1-bit live field keeps dead rows last. Exact — codes are
+    # monotone (and injective) per key.
 
-    def _pack_sort_keys(self, keys, cols, live):
-        operands = sum(2 if v is not None else 1 for _, v, _, _ in keys)
-        if operands < self._SORT_PACK_MIN_OPERANDS:
-            return keys
-        # plan: which keys are packable ints (need stats or one batched fetch)
+    def _sort_words(self, keys, cols, live, include_live=True):
+        """keys: (data, valid, ascending, nulls_first) in major->minor
+        order; cols: aligned Column|None for cached bounds (None or
+        stats-less columns fetch bounds in one batched device round trip).
+        Returns the int64 word list for K.sort_by_words/K.group_by_words."""
+        words = []
+        packer = _WordPacker(words.append)
+        if include_live:
+            packer.add(jnp.where(live, 0, 1).astype(jnp.int64), 1)
         packable = [
             not jnp.issubdtype(d.dtype, jnp.floating) for d, _, _, _ in keys
         ]
-        # packing pays off only if some run of >=2 consecutive ints exists
-        has_run = any(
-            packable[i] and packable[i + 1] for i in range(len(keys) - 1)
-        )
-        if not has_run:
-            return keys
+        stats_list = []
+        wanted = []
+        for (d, v, _, _), c, pk in zip(keys, cols, packable):
+            if c is not None and c.dictionary is not None:
+                # dictionary codes/ranks span [0, len) statically: no stats
+                # lookup and no device fetch needed
+                stats_list.append(
+                    _DictStats(0, max(len(c.dictionary) - 1, 0))
+                )
+            else:
+                stats_list.append(c.stats if c is not None else None)
+            wanted.append(pk)
         bounds = _resolve_bounds(
-            [k[0] for k in keys],
-            [k[1] for k in keys],
-            [c.stats if c is not None else None for c in cols],
-            packable,
+            [k[0] for k in keys], [k[1] for k in keys], stats_list, wanted,
             live,  # dead/padded rows must not widen the spans
         )
-        out = []
-        packer = _WordPacker(lambda w: out.append((w, None, True, True)))
         for (d, v, asc, nf), pk, b in zip(keys, packable, bounds):
-            if not pk:
-                packer.flush()
-                out.append((d, v, asc, nf))
+            if nf is None:
+                nf = asc
+            width = None
+            if pk:
+                vmin, vmax = b
+                if vmax < vmin:  # empty/all-null: constant key, skip
+                    continue
+                span = vmax - vmin + 3  # 1..span-2; 0, span-1 for NULL
+                width = max(1, int(span - 1).bit_length())
+            if width is not None and width <= 62:
+                d64 = d.astype(jnp.int64)
+                code = (d64 - vmin + 1) if asc else (vmax - d64 + 1)
+                if v is not None:
+                    code = jnp.where(v, code, 0 if nf else span - 1)
+                packer.add(code, width)
                 continue
-            vmin, vmax = b
-            if vmax < vmin:  # empty/all-null: constant key, skip entirely
-                continue
-            span = vmax - vmin + 3  # codes 1..span-2; 0 and span-1 for NULL
-            width = max(1, int(span - 1).bit_length())
-            if width > 62:
-                packer.flush()
-                out.append((d, v, asc, nf))
-                continue
-            d64 = d.astype(jnp.int64)
-            code = (d64 - vmin + 1) if asc else (vmax - d64 + 1)
+            # standalone word: null rank into the shared stream, then the
+            # value as its own full-width word. Ints fold direction via
+            # order-reversing bitwise not; floats stay NATIVE f64 words
+            # (the canonical kv kernel jit-caches per dtype, and this TPU
+            # toolchain cannot bitcast emulated 64-bit types) with -0.0
+            # normalized, NaN lifted into a 1-bit rank field (Spark: NaN
+            # sorts greater than +inf), and direction folded by negation.
             if v is not None:
-                code = jnp.where(v, code, 0 if nf else span - 1)
-            packer.add(code, width)
+                packer.add(
+                    jnp.where(v, 1 if nf else 0, 0 if nf else 1).astype(
+                        jnp.int64
+                    ),
+                    1,
+                )
+            if pk:
+                w = d.astype(jnp.int64)
+                if not asc:
+                    w = ~w
+                if v is not None:
+                    w = jnp.where(v, w, 0)
+            else:
+                w = d.astype(jnp.float64)
+                if v is not None:
+                    # mask nulls FIRST: a NULL row whose payload happens to
+                    # be NaN (e.g. x/0 with valid=False) must get the same
+                    # nan_rank as every other NULL row
+                    w = jnp.where(v, w, 0.0)
+                w = jnp.where(w == 0.0, 0.0, w)  # -0.0 == 0.0
+                nan = jnp.isnan(w)
+                nan_rank = jnp.where(nan, 1 if asc else 0, 0 if asc else 1)
+                packer.add(nan_rank.astype(jnp.int64), 1)
+                w = jnp.where(nan, 0.0, w)
+                if not asc:
+                    w = -w
+            packer.flush()
+            words.append(w)
         packer.flush()
-        return out
+        return words
+
+    def _group_words(self, active_cols, live):
+        """Word encoding for group-by adjacency (equality only): the sort
+        encoding with asc/nulls-first defaults is injective, so equal words
+        <=> equal keys and group enumeration order == key sort order."""
+        keys = []
+        for c in active_cols:
+            d = c.data
+            if d.dtype == jnp.bool_:
+                d = d.astype(jnp.int32)
+            keys.append((d, c.valid, True, True))
+        return self._sort_words(keys, active_cols, live)
 
     # -- distributed sort -------------------------------------------------
     # ORDER BY over a mesh-sharded table: range-partitioned samplesort +
@@ -340,11 +452,13 @@ class Executor:
         rnames = list(right.columns)
         lkeys, lvalids, rkeys, rvalids = [], [], [], []
         for ln, rn in zip(names, rnames):
-            lk, rk = self._join_key_pair(dl.columns[ln], right.columns[rn])
-            lkeys.append(lk.data)
-            lvalids.append(lk.valid)
-            rkeys.append(rk.data)
-            rvalids.append(rk.valid)
+            for lk, rk in zip(
+                *self._join_key_pair(dl.columns[ln], right.columns[rn])
+            ):
+                lkeys.append(lk.data)
+                lvalids.append(lk.valid)
+                rkeys.append(rk.data)
+                rvalids.append(rk.valid)
         # NULLs compare equal in set ops: fold validity into the key and add
         # one null-flag key per column on BOTH sides (sides can differ in
         # nullability; the flag lists must stay aligned)
@@ -504,11 +618,11 @@ class Executor:
         rcols = [rev.eval(e) for e in right_keys]
         lk, lv, rk, rv = [], [], [], []
         for a, b in zip(lcols, rcols):
-            ca, cb = self._join_key_pair(a, b)
-            lk.append(ca.data)
-            lv.append(ca.valid)
-            rk.append(cb.data)
-            rv.append(cb.valid)
+            for ca, cb in zip(*self._join_key_pair(a, b)):
+                lk.append(ca.data)
+                lv.append(ca.valid)
+                rk.append(cb.data)
+                rv.append(cb.valid)
         llive = left.row_mask()
         rlive = right.row_mask()
         fast = self._try_dense_join(
@@ -812,7 +926,11 @@ class Executor:
         return mask & table.row_mask()
 
     def _join_key_pair(self, a: Column, b: Column):
-        """Align join key dtypes (incl. cross-dictionary string unification)."""
+        """Align join key dtypes (incl. cross-dictionary string unification).
+        Returns ([left_cols], [right_cols]) — one column pair for most
+        types; float64 keys expand to an exact (exponent, mantissa) pair
+        (bitcast on s64 does not compile on this TPU toolchain, and a
+        single int64 word cannot hold a float64 injectively)."""
         if a.dtype.is_string != b.dtype.is_string:
             # implicit coercion (Spark casts the string side): parse the
             # string key as the other side's type, e.g. invn_date = d_date
@@ -824,32 +942,28 @@ class Executor:
         if a.dtype.is_string or b.dtype.is_string:
             ca, cb, uni = unify_dictionaries(a, b)
             return (
-                Column(ca, a.dtype, a.valid, uni),
-                Column(cb, b.dtype, b.valid, uni),
+                [Column(ca, a.dtype, a.valid, uni)],
+                [Column(cb, b.dtype, b.valid, uni)],
             )
         if a.dtype.is_decimal or b.dtype.is_decimal:
             s = max(a.dtype.scale if a.dtype.is_decimal else 0,
                     b.dtype.scale if b.dtype.is_decimal else 0)
             target = DType("decimal", 38, s)
             return (
-                _cast_column(a, target, a.data.shape[0]),
-                _cast_column(b, target, b.data.shape[0]),
+                [_cast_column(a, target, a.data.shape[0])],
+                [_cast_column(b, target, b.data.shape[0])],
             )
         if a.dtype.kind == "float64" or b.dtype.kind == "float64":
-            # kernels compare keys as int64, which would truncate floats;
-            # bitcast instead (after normalizing -0.0 and NaN, Spark-style)
-            def as_bits(c):
+            # kernels compare keys as int64, which would truncate floats
+            def as_keys(c):
                 f = _cast_column(c, FLOAT64, c.data.shape[0])
-                x = f.data
-                x = jnp.where(x == 0.0, 0.0, x)
-                x = jnp.where(jnp.isnan(x), jnp.nan, x)
-                bits = jax.lax.bitcast_convert_type(x, jnp.int64)
-                return Column(bits, INT64, f.valid)
+                ew, mw = K.float_key_words(f.data)
+                return [Column(ew, INT64, f.valid), Column(mw, INT64, f.valid)]
 
-            return as_bits(a), as_bits(b)
+            return as_keys(a), as_keys(b)
         return (
-            _cast_column(a, INT64, a.data.shape[0]),
-            _cast_column(b, INT64, b.data.shape[0]),
+            [_cast_column(a, INT64, a.data.shape[0])],
+            [_cast_column(b, INT64, b.data.shape[0])],
         )
 
     def _pair_table(self, left, right, li, ri, nrows, rnull, lnull=None):
@@ -885,21 +999,64 @@ class Executor:
 
     # ------------------------------------------------------------------
     def _exec_aggregate(self, node: P.Aggregate) -> Table:
-        child = self.execute(node.child)
+        child, live, nlive = self._agg_input(node)
         if node.grouping_sets is None:
-            return self._aggregate_once(child, node.keys, node.aggs, None)
+            return self._aggregate_once(
+                node.keys, node.aggs, None, child, live, nlive
+            )
         parts = []
         for s in node.grouping_sets:
-            parts.append(self._aggregate_once(child, node.keys, node.aggs, s))
+            parts.append(
+                self._aggregate_once(node.keys, node.aggs, s, child, live,
+                                     nlive)
+            )
         out = parts[0]
         for p in parts[1:]:
             out = self._concat(out, p)
         return out
 
-    def _aggregate_once(self, child, key_items, agg_items, subset):
+    def _agg_input(self, node: P.Aggregate):
+        """Fuse a directly-nested Filter into the aggregation as a live
+        mask instead of materializing the compacted filter output. Saves
+        the count sync + full-width gather per aggregate-over-filter —
+        the q9 shape (15 scalar subqueries, each a global aggregate over a
+        filtered fact scan) runs entirely async on device this way."""
+        ch = node.child
+        if isinstance(ch, P.Filter) and id(ch) not in self._cte_cache:
+            base = self.execute(ch.child)
+            mask = self._predicate_mask(base, ch.predicate)
+            return base, mask, None
+        t = self.execute(ch)
+        return t, t.row_mask(), t.nrows
+
+    def _aggregate_once(self, key_items, agg_items, subset, child, live,
+                        nlive):
+        # stash grouping state for grouping()/distinct-agg helpers, saving
+        # the previous values: a scalar subquery inside an aggregate
+        # argument re-enters _aggregate_once and must not clobber the
+        # outer aggregation's state
+        prev = (
+            getattr(self, "_current_agg_keys", None),
+            getattr(self, "_current_agg_live", None),
+            getattr(self, "_current_agg_nlive", None),
+        )
         self._current_agg_keys = key_items
+        self._current_agg_live = live
+        self._current_agg_nlive = nlive
+        try:
+            return self._aggregate_once_inner(
+                key_items, agg_items, subset, child, live, nlive
+            )
+        finally:
+            (
+                self._current_agg_keys,
+                self._current_agg_live,
+                self._current_agg_nlive,
+            ) = prev
+
+    def _aggregate_once_inner(self, key_items, agg_items, subset, child,
+                              live, nlive):
         ev = self._evaluator(child)
-        live = child.row_mask()
         key_cols = []
         for i, (e, name) in enumerate(key_items):
             if subset is not None and i not in subset:
@@ -908,34 +1065,26 @@ class Executor:
                 key_cols.append(ev.eval(e))
         active = [c for c in key_cols if c is not None]
 
-        if active and child.nrows > 0:
+        if active and (nlive is None or nlive > 0):
             direct = self._try_direct_agg(
                 child, key_items, key_cols, agg_items, subset, ev, live
             )
             if direct is not None:
                 return direct
 
-        packed = None
+        words = None
         if active:
-            keys = []
-            valids = []
-            for c in active:
-                data = c.data
-                if c.dtype.is_string:
-                    pass  # codes are group-stable within one table
-                if data.dtype == jnp.bool_:
-                    data = data.astype(jnp.int32)
-                keys.append(data)
-                valids.append(c.valid)
-            packed = self._pack_group_keys(active, live)
-            if packed is not None:
-                keys, valids = packed, [None] * len(packed)
-            order, gid, ngroups = K.group_rows(keys, valids, live, child.nrows)
+            words = self._group_words(active, live)
+            # nlive None (fused filter mask): group_by_words syncs the count
+            order, gid, ngroups = K.group_by_words(words, live, nlive)
         else:
-            # single global group over live rows
-            order = K.sort_indices([], live)
+            # single global group: segment reductions are order-independent,
+            # so no sort at all — identity order, weight = live mask. SQL
+            # yields exactly one row even over empty input (weights produce
+            # the NULL/0 aggregate values).
+            order = None
             gid = jnp.zeros(child.cap, jnp.int32)
-            ngroups = 1 if child.nrows > 0 else 0
+            ngroups = 1
         if ngroups == 0:
             if active:
                 # empty input, grouped agg -> empty result
@@ -945,56 +1094,11 @@ class Executor:
                 )
             ngroups = 1  # global agg over empty input yields one row
         gcap = bucket_cap(ngroups)
-        live_sorted = live[order]
+        live_sorted = live if order is None else live[order]
         return self._agg_output(
             child, key_items, key_cols, agg_items, subset,
-            order, gid, ngroups, ev, gcap, live_sorted, packed,
+            order, gid, ngroups, ev, gcap, live_sorted, words,
         )
-
-    # -- group-key packing -------------------------------------------------
-    # XLA TPU sort compile time explodes with comparator operand count:
-    # q4's 8-key year_total grouping (16 lexsort operands with null ranks)
-    # took >30 min to compile. Grouping only needs EQUALITY-preserving
-    # adjacency, so N integer keys pack exactly into 1-2 mixed-radix int64
-    # words (code 0 reserved per key for NULL) and the sort compiles a
-    # 2-3 operand comparator in seconds. Exact — never hash-collides.
-    _PACK_MIN_OPERANDS = 4
-    _PACK_MAX_WORDS = 3
-
-    def _pack_group_keys(self, active_cols, live):
-        operands = sum(2 if c.valid is not None else 1 for c in active_cols)
-        if operands < self._PACK_MIN_OPERANDS:
-            return None
-        datas, valids = [], []
-        for c in active_cols:
-            if jnp.issubdtype(c.data.dtype, jnp.floating):
-                return None  # float keys: no exact integer radix
-            datas.append(c.data.astype(jnp.int64))
-            valids.append(c.valid)
-        bounds = _resolve_bounds(
-            datas, valids, [c.stats for c in active_cols],
-            [True] * len(datas), live,
-        )
-        words = []
-        packer = _WordPacker(words.append)
-        for d, v, (vmin, vmax) in zip(datas, valids, bounds):
-            if vmax < vmin:  # all-null/empty column: single code
-                vmin, vmax = 0, 0
-                d = jnp.zeros_like(d)
-            span = vmax - vmin + 2  # +1 for the reserved NULL code 0
-            width = max(1, int(span - 1).bit_length())
-            code = d - vmin + 1
-            if v is not None:
-                code = jnp.where(v, code, 0)
-            if width > 62:
-                return None  # absurd range: fall back to plain lexsort
-            packer.add(code, width)
-            if len(words) >= self._PACK_MAX_WORDS:
-                return None
-        packer.flush()
-        if len(words) > self._PACK_MAX_WORDS:
-            return None
-        return words
 
     # -- direct (sort-free) aggregation ----------------------------------
     # When the combined group-key domain is small (the TPC-DS norm), group
@@ -1091,7 +1195,7 @@ class Executor:
     def _agg_output(
         self, child, key_items, key_cols, agg_items, subset,
         order, gid, ngroups, ev, gcap=None, live_sorted=None,
-        packed_keys=None,
+        key_words=None,
     ):
         if ngroups == 0:
             cols = {}
@@ -1105,8 +1209,10 @@ class Executor:
             for agg, name in agg_items:
                 cols[name] = Column(jnp.zeros(1, jnp.int64), INT64, jnp.zeros(1, bool))
             return Table(cols, 0)
-        first_idx = K.segment_starts(gid, gcap)
-        first_rows = order[jnp.clip(first_idx, 0, child.cap - 1)]
+        first_rows = None
+        if order is not None:
+            first_idx = K.segment_starts(gid, gcap)
+            first_rows = order[jnp.clip(first_idx, 0, child.cap - 1)]
         cols = {}
         for i, ((e, name), c) in enumerate(zip(key_items, key_cols)):
             if c is None:
@@ -1125,13 +1231,13 @@ class Executor:
         for agg, name in agg_items:
             cols[name] = self._eval_agg(
                 agg, ev, order, gid, gcap, live_sorted, ngroups, child, subset,
-                key_cols, packed_keys,
+                key_cols, key_words,
             )
         return Table(cols, ngroups)
 
     def _eval_agg(
         self, agg: E.Agg, ev, order, gid, gcap, live_sorted, ngroups, child,
-        subset, key_cols, packed_keys=None,
+        subset, key_cols, key_words=None,
     ) -> Column:
         fn = agg.fn
         if fn == "grouping":
@@ -1149,7 +1255,7 @@ class Executor:
             return Column(v, DType("int32"))
         if agg.distinct:
             return self._eval_distinct_agg(
-                agg, ev, child, subset, key_cols, gcap, ngroups, packed_keys
+                agg, ev, child, subset, key_cols, gcap, ngroups, key_words
             )
         if fn == "count" and agg.arg is None:
             counts = K.segment_reduce(
@@ -1231,7 +1337,7 @@ class Executor:
         return dtype.kind == "float64"
 
     def _eval_distinct_agg(self, agg, ev, child, subset, key_cols, gcap,
-                           ngroups, packed_keys=None):
+                           ngroups, key_words=None):
         """count(distinct x) / sum(distinct x): two-level grouping.
 
         Null values of x stay live through both passes (so every outer group
@@ -1239,39 +1345,39 @@ class Executor:
         enumerates groups in the same sorted-key order) but carry zero weight
         in the final reduction (distinct aggs ignore nulls)."""
         c = ev.eval(agg.arg)
-        live = child.row_mask()
-        keys = []
-        valids = []
-        for i, kc in enumerate(key_cols):
-            if kc is None or (subset is not None and i not in subset):
-                continue
-            d = kc.data
-            if d.dtype == jnp.bool_:
-                d = d.astype(jnp.int32)
-            keys.append(d)
-            valids.append(kc.valid)
-        # the main pass's packed outer keys (computed once in
-        # _aggregate_once): monotone codes keep group enumeration order
-        # identical to the unpacked sort, so positions still align
-        if packed_keys is not None:
-            gkeys, gvalids = list(packed_keys), [None] * len(packed_keys)
+        live = self._current_agg_live
+        d = c.data
+        if d.dtype == jnp.bool_:
+            d = d.astype(jnp.int32)
+        # the main pass's outer-key words: monotone codes keep group
+        # enumeration order identical across passes, so positions align
+        gwords = list(key_words) if key_words else []
+        if gwords:
+            vwords = self._sort_words(
+                [(d, c.valid, True, True)], [c], live, include_live=False
+            )
+            words2 = gwords + vwords
         else:
-            gkeys, gvalids = keys, valids
-        order2, gid2, ng2 = K.group_rows(
-            gkeys + [c.data], gvalids + [c.valid], live, child.nrows
+            words2 = self._sort_words([(d, c.valid, True, True)], [c], live)
+        order2, gid2, ng2 = K.group_by_words(
+            words2, live, self._current_agg_nlive
         )
         g2cap = bucket_cap(max(ng2, 1))
         first2 = K.segment_starts(gid2, g2cap)
         rows2 = order2[jnp.clip(first2, 0, child.cap - 1)]
         live2 = jnp.arange(g2cap) < ng2
         cvalid2 = None if c.valid is None else c.valid[rows2]
-        # re-group the distinct rows by the outer keys only
-        if keys:
-            okeys = [k[rows2] for k in gkeys]
-            ovalids = [None if v is None else v[rows2] for v in gvalids]
-            order3, gid3, ng3 = K.group_rows(okeys, ovalids, live2, ng2)
+        # re-group the distinct rows by the outer keys only. A fresh live2
+        # word leads: the gathered words' embedded live bit reflects the
+        # ORIGINAL rows' liveness, not the distinct slots' (dead slots gather
+        # an arbitrary live row when the table has no dead tail).
+        if gwords:
+            okeys = [jnp.where(live2, jnp.int64(0), jnp.int64(1))]
+            okeys += [w[rows2] for w in gwords]
+            order3, gid3, ng3 = K.group_by_words(okeys, live2)
         else:
-            order3 = K.sort_indices([], live2)
+            # global distinct: reductions are order-independent
+            order3 = jnp.arange(g2cap, dtype=jnp.int32)
             gid3 = jnp.zeros(g2cap, jnp.int32)
             ng3 = 1 if ng2 > 0 else 0
         if ng3 == 0:
@@ -1310,28 +1416,35 @@ class Executor:
     def _eval_window(self, child: Table, wf: E.WindowFn) -> Column:
         ev = self._evaluator(child)
         live = child.row_mask()
-        pkeys, pvalids = [], []
+        pkeys = []
+        pcols = []
         for e in wf.partition_by:
             c = ev.eval(e)
             d = c.data.astype(jnp.int32) if c.data.dtype == jnp.bool_ else c.data
-            pkeys.append(d)
-            pvalids.append(c.valid)
+            pkeys.append((d, c.valid, True, True))
+            pcols.append(c)
         okeys = []
+        ocols = []
         for e, asc in wf.order_by:
             c = ev.eval(e)
             d = c.data
             if c.dtype.is_string:
                 d, _ = sort_dictionary(c)
+            if d.dtype == jnp.bool_:
+                d = d.astype(jnp.int32)
             okeys.append((d, c.valid, asc, asc))
-        sort_key_list = [
-            (d, v, True, True) for d, v in zip(pkeys, pvalids)
-        ] + okeys
-        order = K.sort_indices(sort_key_list, live)
+            ocols.append(c)
+        # partition words carry the live bit (dead rows last); order words
+        # are a separate list so partition boundaries can be read off the
+        # sorted partition words alone
+        pwords = self._sort_words(pkeys, pcols, live)
+        owords = self._sort_words(okeys, ocols, live, include_live=False)
+        order = K.sort_by_words(pwords + owords)
+        sorted_ow = [w[order] for w in owords]
         # partition group ids over sorted rows
         if pkeys:
-            sorted_p = [k[order] for k in pkeys]
-            sorted_pv = [None if v is None else v[order] for v in pvalids]
-            flags = K._group_flags(sorted_p, sorted_pv, live[order])
+            sorted_p = [w[order] for w in pwords]
+            flags = K._word_flags(sorted_p)
             gid = jnp.cumsum(flags.astype(jnp.int32)) - 1
             nlive = child.nrows
             ng = int(gid[nlive - 1]) + 1 if nlive else 0
@@ -1350,13 +1463,7 @@ class Executor:
                 vals = pos + 1
             else:
                 # order-group boundaries within partitions (ties share a rank)
-                sorted_keys = [d[order] for d, _, _, _ in okeys]
-                sorted_valids = [
-                    None if v is None else v[order] for _, v, _, _ in okeys
-                ]
-                oflags = K._group_flags(
-                    [gid] + sorted_keys, [None] + sorted_valids, live[order]
-                )
+                oflags = K._word_flags([gid] + sorted_ow)
                 ogid = jnp.cumsum(oflags.astype(jnp.int32)) - 1
                 part_first = K.segment_starts(gid, gcap)
                 if fn == "dense_rank":
@@ -1437,13 +1544,7 @@ class Executor:
             if frame is None:
                 # RANGE default: current row's peers (equal order keys) are
                 # in-frame, so read the running value at the peer-group end
-                sorted_keys = [d[order] for d, _, _, _ in okeys]
-                sorted_valids = [
-                    None if v is None else v[order] for _, v, _, _ in okeys
-                ]
-                oflags = K._group_flags(
-                    [gid] + sorted_keys, [None] + sorted_valids, live[order]
-                )
+                oflags = K._word_flags([gid] + sorted_ow)
                 ogid = jnp.cumsum(oflags.astype(jnp.int32)) - 1
                 n_og = int(ogid[child.nrows - 1]) + 1 if child.nrows else 1
                 ogcap = bucket_cap(max(n_og, 1))
@@ -1470,13 +1571,7 @@ class Executor:
             if frame is None:
                 # RANGE: current row's peers (equal order keys) are included,
                 # so take the cumulative value at the END of the peer group
-                sorted_keys = [d[order] for d, _, _, _ in okeys]
-                sorted_valids = [
-                    None if v is None else v[order] for _, v, _, _ in okeys
-                ]
-                oflags = K._group_flags(
-                    [gid] + sorted_keys, [None] + sorted_valids, live[order]
-                )
+                oflags = K._word_flags([gid] + sorted_ow)
                 ogid = jnp.cumsum(oflags.astype(jnp.int32)) - 1
                 n_og = int(ogid[child.nrows - 1]) + 1 if child.nrows else 1
                 ogcap = bucket_cap(max(n_og, 1))
@@ -1595,6 +1690,13 @@ class Executor:
     def _scalar_value(self, e: E.ScalarSubquery):
         key = id(e.plan)
         if key not in self._scalar_cache:
+            cache = self._session_cache()
+            if cache is not None:
+                fp = self._fp(e.plan) + ":" + e.out_name
+                hit = cache.scalars.get(fp)
+                if hit is not None:
+                    self._scalar_cache[key] = hit
+                    return hit
             t = self.execute(e.plan)
             col = t.columns[e.out_name]
             if t.nrows == 0:
@@ -1611,6 +1713,11 @@ class Executor:
                     v if valid else None,
                     col.dtype,
                     col.dictionary,
+                )
+            cache = self._session_cache()
+            if cache is not None:
+                cache.scalars[self._fp(e.plan) + ":" + e.out_name] = (
+                    self._scalar_cache[key]
                 )
         return self._scalar_cache[key]
 
@@ -1635,14 +1742,9 @@ class Executor:
         return Table(cols, nrows)
 
     def _distinct_table(self, t: Table) -> Table:
-        keys, valids = [], []
-        for c in t.columns.values():
-            d = c.data
-            if d.dtype == jnp.bool_:
-                d = d.astype(jnp.int32)
-            keys.append(d)
-            valids.append(c.valid)
-        order, gid, ng = K.group_rows(keys, valids, t.row_mask(), t.nrows)
+        live = t.row_mask()
+        words = self._group_words(list(t.columns.values()), live)
+        order, gid, ng = K.group_by_words(words, live, t.nrows)
         gcap = bucket_cap(max(ng, 1))
         first = K.segment_starts(gid, gcap)
         rows = order[jnp.clip(first, 0, t.cap - 1)]

@@ -141,6 +141,54 @@ class MaterializedScan(PlanNode):
     table: object = None  # columnar.Table
 
 
+def fingerprint(node: PlanNode) -> str:
+    """Stable structural identity of a plan subtree.
+
+    Two separately-bound plans with the same structure (same scans, exprs,
+    operators) get equal fingerprints, so executor results can be reused
+    across statements — e.g. the shared CTE text of query14_part1/_part2
+    re-resolves to the same key (reference analogue: Spark reuses nothing
+    across spark.sql calls; this is the eager engine's materialized-CTE
+    win). Shared subtrees are serialized once and back-referenced, which
+    also keeps the cost linear in plan size."""
+    import dataclasses
+    import hashlib
+
+    out = []
+    memo = {}
+
+    def emit(v):
+        if isinstance(v, MaterializedScan):
+            # a populated table is identity, not structure: never let two
+            # different in-memory tables share a fingerprint
+            t = "none" if v.table is None else str(id(v.table))
+            out.append(f"MScan:{v.name}:{t}")
+        elif isinstance(v, (PlanNode, E.Expr)):
+            key = id(v)
+            if key in memo:
+                out.append(f"@{memo[key]}")
+                return
+            memo[key] = len(memo)
+            out.append(type(v).__name__)
+            out.append("(")
+            for f in dataclasses.fields(v):
+                emit(getattr(v, f.name))
+            out.append(")")
+        elif isinstance(v, (list, tuple)):
+            out.append("[")
+            for x in v:
+                emit(x)
+            out.append("]")
+        elif v is None or isinstance(v, (str, int, float, bool, frozenset)):
+            out.append(repr(v))
+        else:
+            # DType and other small value objects: repr is structural
+            out.append(type(v).__name__ + ":" + repr(v))
+
+    emit(node)
+    return hashlib.sha256("\x00".join(out).encode()).hexdigest()
+
+
 def explain(node: PlanNode, indent=0) -> str:
     pad = "  " * indent
     name = type(node).__name__

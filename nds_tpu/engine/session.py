@@ -58,6 +58,57 @@ def _enable_persistent_compile_cache():
         pass  # older jax without the knobs: in-memory cache only
 
 
+class _PlanResultCache:
+    """Byte-budgeted LRU of executed plan subtrees, keyed by structural
+    fingerprint (plan.fingerprint). Lets repeated CTE/subquery text reuse
+    materialized device tables ACROSS statements — e.g. query14_part1 and
+    _part2 share their cross_items/avg_sales CTEs, and a run_script's
+    statements share repeated subtrees. Cleared whenever the catalog
+    changes (any registration, drop, or invalidation)."""
+
+    def __init__(self, budget_bytes: int):
+        from collections import OrderedDict
+
+        self.budget = budget_bytes
+        self.map = OrderedDict()  # fp -> (table, nbytes)
+        self.nbytes = 0
+        self.scalars = {}  # fp -> (value, dtype, dictionary)
+
+    @staticmethod
+    def _table_bytes(table) -> int:
+        total = 0
+        for c in table.columns.values():
+            total += int(c.data.nbytes)
+            if c.valid is not None:
+                total += int(c.valid.nbytes)
+        return total
+
+    def get(self, fp):
+        hit = self.map.get(fp)
+        if hit is None:
+            return None
+        self.map.move_to_end(fp)
+        return hit[0]
+
+    def put(self, fp, table):
+        if fp in self.map:
+            self.map.move_to_end(fp)
+            return
+        nb = self._table_bytes(table)
+        if nb > self.budget:
+            return
+        self.map[fp] = (table, nb)
+        self.nbytes += nb
+        while self.nbytes > self.budget and len(self.map) > 1:
+            _, (_, old_nb) = self.map.popitem(last=False)
+            self.nbytes -= old_nb
+
+    def clear(self):
+        self.map.clear()
+        self.scalars.clear()
+        self.nbytes = 0
+
+
 class _Entry:
     def __init__(self, schema=None, arrow=None, path=None, fmt=None):
         self.schema = schema  # nds_tpu Schema or None (infer)
@@ -226,6 +277,7 @@ class Catalog:
         return Table(cols, t.nrows)
 
     def invalidate(self, name):
+        self.session._catalog_changed()
         e = self.entries.get(name)
         if e is not None:
             e.device_cols = {}
@@ -301,17 +353,28 @@ class Session:
         self.mesh = mesh
         self.catalog = Catalog(self)
         self._listeners = []  # task-failure observers (harness parity)
+        self.plan_cache = _PlanResultCache(
+            int(self.conf.get("engine.plan_cache_bytes", 2 << 30))
+        )
+
+    def _catalog_changed(self):
+        """Any registration/drop/invalidation: cached plan results may now
+        be stale — drop them all."""
+        self.plan_cache.clear()
 
     # ---- registration ----------------------------------------------------
     def register_arrow(self, name, arrow: pa.Table, schema=None):
+        self._catalog_changed()
         self.catalog.entries[name.lower()] = _Entry(schema=schema, arrow=arrow)
 
     def register_parquet(self, name, path, schema=None):
+        self._catalog_changed()
         self.catalog.entries[name.lower()] = _Entry(
             schema=schema, path=path, fmt="parquet"
         )
 
     def register_orc(self, name, path, schema=None):
+        self._catalog_changed()
         self.catalog.entries[name.lower()] = _Entry(
             schema=schema, path=path, fmt="orc"
         )
@@ -326,6 +389,7 @@ class Session:
     def register_csv_warehouse(self, name, path, schema):
         """Transcoded csv warehouse dir (comma-delimited part files, possibly
         hive-partitioned) — lazy, like parquet registration."""
+        self._catalog_changed()
         self.catalog.entries[name.lower()] = _Entry(
             schema=schema, path=path, fmt="csv"
         )
@@ -333,6 +397,7 @@ class Session:
     def register_lakehouse(self, name, path, schema=None):
         """Snapshot-manifest (ACID) table — the Iceberg/Delta-equivalent
         warehouse format used by the Data Maintenance phase."""
+        self._catalog_changed()
         self.catalog.entries[name.lower()] = _Entry(
             schema=schema, path=path, fmt="lakehouse"
         )
@@ -352,6 +417,7 @@ class Session:
                 )
 
     def drop(self, name):
+        self._catalog_changed()
         self.catalog.entries.pop(name.lower(), None)
 
     # ---- listeners (reference: python_listener/PythonListener.py) --------

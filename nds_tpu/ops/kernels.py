@@ -5,10 +5,10 @@ engine (reference: BASELINE.json north star; nds/power_run_gpu.template:20-41
 merely configures them). Here each primitive is a `jit`-compiled JAX function
 over dense padded buffers:
 
-  - compaction (filter)          nonzero + gather
+  - compaction (filter)          cumsum + scatter + gather
   - equi-join (inner/outer/semi/anti)  hash + sort + searchsorted + verify
-  - group-by aggregation         lexsort + boundary flags + segment reduce
-  - order-by                     lexsort with null ordering + live-row key
+  - group-by aggregation         word sort + boundary flags + segment reduce
+  - order-by                     word sort with null/direction folding
   - window functions             partition sort + segment scan/reduce
 
 Design rules (TPU/XLA-first):
@@ -18,9 +18,12 @@ Design rules (TPU/XLA-first):
     per kernel (`int(x.sum())`) and select the bucket for the next kernel.
   * Hash matches are *candidates only*: every join verifies real key equality
     on the matched pairs, so hash collisions can never produce wrong results.
-  * Sorting uses `jnp.lexsort` (XLA's bitonic/radix sort, fast on TPU); the
-    most-significant key is always the live-row mask so padding tails sort to
-    the end and drop out.
+  * EVERY ordering routes through ONE canonical stable (key, iota) kv-sort
+    kernel per input cap (`sort_by_words`): XLA:TPU sort compiles cost
+    ~10-12 s per comparator operand at fact shapes on a 1-core host, so
+    multi-key comparisons run as stable LSD passes over int64/float64 words
+    instead of one multi-operand comparator kernel per query. A leading
+    live word keeps padding tails at the end.
 """
 
 from __future__ import annotations
@@ -69,10 +72,31 @@ def hash_columns(cols, valids) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("out_cap",))
+@partial(jax.jit, static_argnames=())
+def _compact_full(mask: jnp.ndarray) -> jnp.ndarray:
+    """Indices of True entries, packed to the front, 0-padded, full length.
+
+    cumsum + scatter instead of jnp.nonzero: XLA:TPU compiles this ~2-4x
+    faster, and keeping the output full-length means ONE compile per input
+    cap regardless of the caller's out_cap (the slice below is a trivial
+    compile). With compiles costing seconds per shape on a 1-core host,
+    (shape x out_cap) kernel proliferation was a top cold-start cost."""
+    n = mask.shape[0]
+    pos = jnp.where(mask, jnp.cumsum(mask.astype(jnp.int32)) - 1, n)
+    return (
+        jnp.zeros(n, jnp.int32)
+        .at[pos]
+        .set(jnp.arange(n, dtype=jnp.int32), mode="drop")
+    )
+
+
 def compact_indices(mask: jnp.ndarray, out_cap: int) -> jnp.ndarray:
     """Indices of True entries, padded with 0 to out_cap."""
-    return jnp.nonzero(mask, size=out_cap, fill_value=0)[0].astype(jnp.int32)
+    full = _compact_full(mask)
+    n = mask.shape[0]
+    if out_cap <= n:
+        return jax.lax.slice(full, (0,), (out_cap,))
+    return jnp.pad(full, (0, out_cap - n))
 
 
 def mask_count(mask: jnp.ndarray) -> int:
@@ -82,6 +106,86 @@ def mask_count(mask: jnp.ndarray) -> int:
 # ---------------------------------------------------------------------------
 # Sorting
 # ---------------------------------------------------------------------------
+
+
+# -- canonical kv sort ------------------------------------------------------
+# XLA:TPU sort compile time is ~10-12 s per comparator operand at fact-table
+# shapes (measured on the 1-core bench host), and every distinct
+# (operand count, shapes) tuple is its own kernel. The engine therefore
+# routes EVERY ordering through one canonical kernel: a stable
+# (int64 key, int32 iota) sort — one compile per input cap, persisted in
+# the XLA cache, reused by every sort/group/join in every query.
+# Multi-word keys run as stable LSD passes over the same kernel.
+
+
+@partial(jax.jit, static_argnames=())
+def _kv_sort_perm(key: jnp.ndarray) -> jnp.ndarray:
+    iota = jnp.arange(key.shape[0], dtype=jnp.int32)
+    return jax.lax.sort((key, iota), num_keys=1, is_stable=True)[1]
+
+
+def kv_sort_perm(key: jnp.ndarray) -> jnp.ndarray:
+    """Stable ascending argsort of one int64 key via the canonical kernel."""
+    return _kv_sort_perm(key.astype(I64))
+
+
+def sort_by_words(words) -> jnp.ndarray:
+    """Stable lexicographic argsort by a list of int64 words (most
+    significant first): LSD radix over the canonical kv-sort kernel."""
+    perm = None
+    for w in reversed(words):
+        k = w if perm is None else w[perm]
+        p = _kv_sort_perm(k)
+        perm = p if perm is None else perm[p]
+    return perm
+
+
+def float_key_words(x: jnp.ndarray):
+    """Exact injective float64 -> (exponent, mantissa) int64 word pair for
+    join-key equality: equal floats map to equal pairs, distinct to
+    distinct. Built from frexp arithmetic because this TPU toolchain
+    emulates 64-bit types and cannot compile bitcast-convert on s64.
+    Spark semantics: -0.0 == 0.0 and NaN == NaN (normalized); +-inf get
+    reserved exponent codes (frexp on non-finite input is undefined)."""
+    x = x.astype(jnp.float64)
+    x = jnp.where(x == 0.0, 0.0, x)  # -0.0 -> +0.0
+    special = jnp.isnan(x) | jnp.isinf(x)
+    m, e = jnp.frexp(jnp.where(special, 0.0, x))
+    # m = j/2^53 with |j| in [2^52, 2^53): m * 2^53 is exactly integral,
+    # so the pair (e, j) loses nothing. e in [-1073, 1024] for finite x.
+    ew = e.astype(I64)
+    mw = (m * jnp.float64(1 << 53)).astype(I64)
+    ew = jnp.where(jnp.isnan(x), jnp.int64(99999), ew)
+    ew = jnp.where(jnp.isinf(x) & (x > 0), jnp.int64(99998), ew)
+    ew = jnp.where(jnp.isinf(x) & (x < 0), jnp.int64(-99999), ew)
+    mw = jnp.where(special, 0, mw)
+    return ew, mw
+
+
+def group_by_words(words, live_mask, nlive=None):
+    """group_rows over pre-encoded key words (exact encodings: equal words
+    <=> equal keys). The word list must place live rows first (callers fold
+    ~live into the leading word via the packer)."""
+    order = sort_by_words(words)
+    sorted_words = [w[order] for w in words]
+    flags = _word_flags(sorted_words)
+    gid = jnp.cumsum(flags.astype(jnp.int32)) - 1
+    if nlive is None:
+        nlive = mask_count(live_mask)
+    if nlive == 0:
+        return order, gid, 0
+    ngroups = int(gid[nlive - 1]) + 1
+    return order, gid, ngroups
+
+
+@partial(jax.jit, static_argnames=())
+def _word_flags(sorted_words):
+    """Group-boundary flags from adjacent word inequality."""
+    n = sorted_words[0].shape[0]
+    flag = jnp.zeros(n, dtype=bool).at[0].set(True)
+    for w in sorted_words:
+        flag = flag.at[1:].max(w[1:] != w[:-1])
+    return flag
 
 
 def fold_sort_key(data, valid, ascending: bool, nulls_first: bool):
@@ -102,58 +206,57 @@ def fold_sort_key(data, valid, ascending: bool, nulls_first: bool):
     return [null_rank, jnp.where(valid, d, jnp.zeros((), d.dtype))]
 
 
+def key_words(keys, live_mask):
+    """Generic word encoding for (data, valid, ascending, nulls_first) key
+    tuples: a leading live word (dead rows last), then per key a 1-bit
+    null-rank word when nullable, a 1-bit NaN-rank word for floats (Spark:
+    NaN greater than +inf), and the value word with direction folded
+    (order-reversing bitwise not for ints, negation for floats). One word
+    per field — the engine's Executor._sort_words builds tighter mixed-radix
+    packings with bounds; this bounds-free version serves the kernel-level
+    API and tests."""
+    words = [jnp.where(live_mask, jnp.int64(0), jnp.int64(1))]
+    for data, valid, asc, nf in keys:
+        if nf is None:
+            nf = asc
+        if valid is not None:
+            words.append(
+                jnp.where(valid, 1 if nf else 0, 0 if nf else 1).astype(I64)
+            )
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            w = data.astype(jnp.float64)
+            if valid is not None:
+                w = jnp.where(valid, w, 0.0)
+            w = jnp.where(w == 0.0, 0.0, w)  # -0.0 == 0.0
+            nan = jnp.isnan(w)
+            words.append(
+                jnp.where(nan, 1 if asc else 0, 0 if asc else 1).astype(I64)
+            )
+            w = jnp.where(nan, 0.0, w)
+            if not asc:
+                w = -w
+        else:
+            w = data.astype(I64)
+            if not asc:
+                w = ~w
+            if valid is not None:
+                w = jnp.where(valid, w, 0)
+        words.append(w)
+    return words
+
+
 def sort_indices(keys, live_mask: jnp.ndarray) -> jnp.ndarray:
     """Stable multi-key sort; returns row order with live rows first.
 
     `keys` is a list of (data:int64/float64, valid:bool|None, ascending:bool,
-    nulls_first:bool) in major-to-minor significance order. Null ordering and
-    direction are folded into a (null_rank, value) key pair per column.
-    """
-    if len(keys) == 1 and keys[0][1] is None and jnp.issubdtype(
-        keys[0][0].dtype, jnp.integer
-    ):
-        # single non-null integer key (the packed-word norm): fold the
-        # dead-tail into the key value and run a one-operand stable sort —
-        # stability keeps live rows ahead of dead ones on ties, and XLA
-        # compiles a 1-operand comparator instead of 2
-        data, _, ascending, _ = keys[0]
-        d = data.astype(I64)
-        if not ascending:
-            d = -d
-        big = jnp.iinfo(I64).max
-        masked = jnp.where(live_mask, d, big)
-        return jnp.argsort(masked, stable=True).astype(jnp.int32)
-    lex = []  # least-significant first for jnp.lexsort
-    for data, valid, ascending, nulls_first in reversed(keys):
-        lex.extend(reversed(fold_sort_key(data, valid, ascending, nulls_first)))
-    lex.append(~live_mask)  # most significant: dead rows last
-    return jnp.lexsort(tuple(lex)).astype(jnp.int32)
+    nulls_first:bool) in major-to-minor significance order. Runs as stable
+    LSD passes over the canonical kv kernel (sort_by_words)."""
+    return sort_by_words(key_words(keys, live_mask))
 
 
 # ---------------------------------------------------------------------------
 # Grouping (sort-based): group ids + segment reductions
 # ---------------------------------------------------------------------------
-
-
-@partial(jax.jit, static_argnames=())
-def _group_flags(sorted_keys, sorted_valids, live_sorted):
-    """Boundary flags over rows sorted by their group keys."""
-    n = live_sorted.shape[0]
-    flag = jnp.zeros(n, dtype=bool).at[0].set(True)
-    for data, valid in zip(sorted_keys, sorted_valids):
-        if valid is not None:
-            data = jnp.where(valid, data, jnp.zeros((), data.dtype))
-            # split iff nullness differs, or both non-null with unequal values
-            neq = (valid[1:] != valid[:-1]) | (
-                valid[1:] & valid[:-1] & (data[1:] != data[:-1])
-            )
-        else:
-            neq = data[1:] != data[:-1]
-        flag = flag.at[1:].max(neq)
-    # dead rows: open one trailing group so they never merge with a live one
-    dead_start = jnp.roll(live_sorted, 1) & ~live_sorted
-    flag = flag | dead_start
-    return flag
 
 
 def group_rows(keys, valids, live_mask, nlive=None):
@@ -163,23 +266,9 @@ def group_rows(keys, valids, live_mask, nlive=None):
     `gid_sorted[i]` the 0-based group of sorted row i, `ngroups` the number of
     live groups (host int). Nulls form their own group (Spark GROUP BY
     semantics). Pass `nlive` when the live count is already known on the host
-    (a Table's nrows) — it saves one device round trip per groupby.
-    """
-    sort_keys = []
-    for data, valid in zip(keys, valids):
-        sort_keys.append((data, valid, True, True))
-    order = sort_indices(sort_keys, live_mask)
-    sorted_keys = [k[order] for k, _ in zip(keys, valids)]
-    sorted_valids = [None if v is None else v[order] for v in valids]
-    live_sorted = live_mask[order]
-    flags = _group_flags(sorted_keys, sorted_valids, live_sorted)
-    gid = jnp.cumsum(flags.astype(jnp.int32)) - 1
-    if nlive is None:
-        nlive = mask_count(live_mask)
-    if nlive == 0:
-        return order, gid, 0
-    ngroups = int(gid[nlive - 1]) + 1
-    return order, gid, ngroups
+    (a Table's nrows) — it saves one device round trip per groupby."""
+    tuples = [(d, v, True, True) for d, v in zip(keys, valids)]
+    return group_by_words(key_words(tuples, live_mask), live_mask, nlive)
 
 
 @partial(jax.jit, static_argnames=("num_segments", "op"))
@@ -239,11 +328,11 @@ def batched_min_max(datas, valids, live):
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=())
 def _join_prepare(rhash, rlive):
-    """Sort right-side hashes; dead rows get a reserved slot at the end."""
+    """Sort right-side hashes; dead rows get a reserved slot at the end.
+    Eager (not jitted whole) so the sort reuses the canonical kv kernel."""
     rh = jnp.where(rlive, rhash, jnp.iinfo(I64).max)
-    order = jnp.argsort(rh).astype(jnp.int32)
+    order = _kv_sort_perm(rh)
     return rh[order], order
 
 

@@ -140,18 +140,18 @@ def test_negative_keys(monkeypatch):
     assert fast.to_pylist() == slow.to_pylist()
 
 
-def test_group_key_packing_matches_unpacked():
-    """Multi-key group-bys pack into mixed-radix int64 words (the 8-key
-    lexsort comparator made XLA TPU compiles explode); packed and unpacked
-    paths must group identically, nulls and strings included."""
+def test_group_key_words_match_pandas():
+    """Multi-key group-bys encode keys into mixed-radix int64 words sorted
+    by the canonical kv kernel; the grouping must match an independent
+    pandas oracle, nulls and strings included."""
+    import pandas as pd
     import pyarrow as pa
-    from nds_tpu.engine import exec as X
     from nds_tpu.engine.session import Session
 
     rng = np.random.default_rng(11)
     n = 3000
     # `a` spans a huge domain so _try_direct_agg declines and the SORTED
-    # grouping path (the one that packs) is what runs
+    # grouping path (the word-encoded one) is what runs
     t = pa.table({
         "a": rng.integers(-(2 ** 40), 2 ** 40, n),
         "b": pa.array(np.where(rng.random(n) < 0.1, None,
@@ -164,27 +164,40 @@ def test_group_key_packing_matches_unpacked():
     })
     q = ("select a, b, c, d, e, count(*) cnt, sum(v) s from t "
          "group by a, b, c, d, e order by a, b, c, d, e")
+    s = Session()
+    s.register_arrow("t", t)
+    got = s.sql(q).collect().to_pylist()
 
-    def run(min_operands):
-        import unittest.mock as um
-        s = Session()
-        s.register_arrow("t", t)
-        with um.patch.object(X.Executor, "_PACK_MIN_OPERANDS", min_operands):
-            return s.sql(q).collect().to_pylist()
+    df = t.to_pandas()
+    exp = (
+        df.groupby(["a", "b", "c", "d", "e"], dropna=False)
+        .agg(cnt=("v", "size"), s=("v", "sum"))
+        .reset_index()
+        .sort_values(["a", "b", "c", "d", "e"], na_position="first")
+    )
+    expected = [
+        {
+            "a": int(r.a),
+            "b": None if pd.isna(r.b) else int(r.b),
+            "c": None if pd.isna(r.c) else r.c,
+            "d": int(r.d),
+            "e": bool(r.e),
+            "cnt": int(r.cnt),
+            "s": int(r.s),
+        }
+        for r in exp.itertuples()
+    ]
+    assert got == expected
+    assert len(got) > 100
 
-    packed = run(1)       # force packing
-    unpacked = run(10**6)  # force plain lexsort
-    assert packed == unpacked
-    assert len(packed) > 100
 
-
-def test_sort_key_packing_preserves_order():
-    """ORDER BY packing folds direction and null position into monotone
-    codes; every asc/desc x nulls-first/last combination must order rows
-    identically to the unpacked lexsort, with floats left standalone."""
+def test_sort_key_words_preserve_order():
+    """ORDER BY word encoding folds direction and null position into
+    monotone codes (floats via the order-preserving bit transform); every
+    asc/desc x nulls-first/last combination must order rows identically to
+    an independent Python comparator."""
     import pyarrow as pa
-    import unittest.mock as um
-    from nds_tpu.engine import exec as X
+    from functools import cmp_to_key
     from nds_tpu.engine.session import Session
 
     rng = np.random.default_rng(23)
@@ -198,21 +211,39 @@ def test_sort_key_packing_preserves_order():
         "f": rng.random(n) * 10,
         "d": rng.integers(0, 4, n),
     })
+    # every spec ends in `a` (effectively unique), so each ordering is total
     queries = [
-        "select * from t order by a, b, s, d",
-        "select * from t order by b desc, a, d desc, s",
-        "select * from t order by b asc nulls last, d desc, a, s desc",
-        "select * from t order by d, f desc, b, a",  # float splits the run
-        "select * from t order by s desc nulls first, b, d, a",
+        ("select * from t order by a, b, s, d",
+         [("a", 1, 1), ("b", 1, 1), ("s", 1, 1), ("d", 1, 1)]),
+        ("select * from t order by b desc, a, d desc, s",
+         [("b", 0, 0), ("a", 1, 1), ("d", 0, 0), ("s", 1, 1)]),
+        ("select * from t order by b asc nulls last, d desc, a, s desc",
+         [("b", 1, 0), ("d", 0, 0), ("a", 1, 1), ("s", 0, 0)]),
+        ("select * from t order by d, f desc, b, a",  # float standalone word
+         [("d", 1, 1), ("f", 0, 0), ("b", 1, 1), ("a", 1, 1)]),
+        ("select * from t order by s desc nulls first, b, d, a",
+         [("s", 0, 1), ("b", 1, 1), ("d", 1, 1), ("a", 1, 1)]),
     ]
+    s = Session()
+    s.register_arrow("t", t)
+    rows = t.to_pylist()
+    for q, spec in queries:
+        got = s.sql(q).collect().to_pylist()
 
-    def run(min_ops):
-        s = Session()
-        s.register_arrow("t", t)
-        with um.patch.object(X.Executor, "_SORT_PACK_MIN_OPERANDS", min_ops):
-            return [s.sql(q).collect().to_pylist() for q in queries]
+        def cmp(ra, rb):
+            for col, asc, nf in spec:
+                va, vb = ra[col], rb[col]
+                if va is None and vb is None:
+                    continue
+                if va is None:
+                    return -1 if nf else 1
+                if vb is None:
+                    return 1 if nf else -1
+                if va == vb:
+                    continue
+                lt = va < vb
+                return (-1 if lt else 1) if asc else (1 if lt else -1)
+            return 0
 
-    packed = run(1)
-    unpacked = run(10 ** 6)
-    for q, pv, uv in zip(queries, packed, unpacked):
-        assert pv == uv, q
+        expected = sorted(rows, key=cmp_to_key(cmp))
+        assert got == expected, q
