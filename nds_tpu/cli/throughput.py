@@ -37,6 +37,16 @@ def main(argv=None):
     parser.add_argument("--output_prefix")
     parser.add_argument("--output_format", default="parquet")
     parser.add_argument("--floats", action="store_true")
+    parser.add_argument(
+        "--mode", choices=["thread", "process"], default="thread",
+        help="stream concurrency: threads in one process (shared in-memory "
+        "compile cache) or one forked Power Run per stream (the reference "
+        "nds-throughput shape; shares the persistent XLA cache)",
+    )
+    parser.add_argument(
+        "--sub_queries", type=lambda s: [x.strip() for x in s.split(",")],
+        help="comma separated subset of queries to run in each stream",
+    )
     args = parser.parse_args(argv)
     nums = [int(s) for s in args.streams.split(",") if s.strip()]
     stream_paths = {
@@ -52,6 +62,8 @@ def main(argv=None):
         json_summary_folder=args.json_summary_folder,
         output_path=args.output_prefix,
         output_format=args.output_format,
+        mode=args.mode,
+        sub_queries=args.sub_queries,
     )
     print(f"====== Throughput Test Time: {ttt} seconds ======")
 

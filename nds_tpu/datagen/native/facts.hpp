@@ -26,7 +26,9 @@ inline LineVals compute_line(const Ctx& ctx, uint64_t table, int64_t order, int 
   Rng r(ctx.seed, table, order, line + 1);
   LineVals v;
   v.item_sk = r.range(100, 1, (ctx.n_item + 1) / 2) * 2 - 1;  // odd = current SCD row
-  v.has_promo = r.chance(101, 30);
+  // dsdgen keeps nullable fact FKs ~96% populated; promo follows suit
+  // (a 30% rate here made ss_promo_sk 70% null — spec-shape violation)
+  v.has_promo = r.chance(101, 96);
   v.promo_sk = r.range(101, 1, ctx.n_promotion, 1);
   v.quantity = r.range(102, 1, 100);
   v.wholesale = r.dec(103, 1.00, 100.00, 100);

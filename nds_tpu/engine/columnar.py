@@ -335,6 +335,21 @@ def table_to_arrow(table: Table) -> pa.Table:
         flat.append(c.data)
         if c.valid is not None:
             flat.append(c.valid)
+    if any(
+        hasattr(x, "is_fully_addressable") and not x.is_fully_addressable
+        for x in flat
+    ):
+        # multi-process mesh: shards live on other hosts' devices, which
+        # device_get cannot read — all-gather each buffer to every process
+        # first (DCN-tier result collection)
+        from jax.experimental import multihost_utils
+
+        flat = [
+            multihost_utils.process_allgather(x, tiled=True)
+            if hasattr(x, "is_fully_addressable") and not x.is_fully_addressable
+            else x
+            for x in flat
+        ]
     fetched = iter(jax.device_get(flat))
     arrays = []
     for c in table.columns.values():

@@ -77,34 +77,6 @@ class _DictStats:
         self.base_rows = 0
 
 
-class _WordPacker:
-    """Accumulates (code, width) fields into <=62-bit int64 words,
-    emitting each completed word through `emit`. Field order = bit
-    significance order, so lexicographic word compare == field compare."""
-
-    def __init__(self, emit):
-        self._emit = emit
-        self._cur = None
-        self._bits = 0
-
-    def add(self, code, width):
-        if self._bits + width > 62:
-            self.flush()
-        # clamp to the field width: dead/padded rows carry arbitrary values
-        # whose codes can be negative or oversized, and an out-of-range code
-        # would corrupt the whole OR-merged word (live-row codes are always
-        # in range by construction, so this is the identity for them)
-        code = code & ((1 << width) - 1)
-        self._cur = code if self._cur is None else (self._cur << width) | code
-        self._bits += width
-
-    def flush(self):
-        if self._cur is not None:
-            self._emit(self._cur)
-        self._cur = None
-        self._bits = 0
-
-
 class Executor:
     def __init__(self, catalog, on_task_failure=None):
         """catalog: object with .load(table_name) -> Table.
@@ -257,15 +229,10 @@ class Executor:
         order; cols: aligned Column|None for cached bounds (None or
         stats-less columns fetch bounds in one batched device round trip).
         Returns the int64 word list for K.sort_by_words/K.group_by_words."""
-        words = []
-        packer = _WordPacker(words.append)
-        if include_live:
-            packer.add(jnp.where(live, 0, 1).astype(jnp.int64), 1)
         packable = [
             not jnp.issubdtype(d.dtype, jnp.floating) for d, _, _, _ in keys
         ]
         stats_list = []
-        wanted = []
         for (d, v, _, _), c, pk in zip(keys, cols, packable):
             if c is not None and c.dictionary is not None:
                 # dictionary codes/ranks span [0, len) statically: no stats
@@ -275,66 +242,49 @@ class Executor:
                 )
             else:
                 stats_list.append(c.stats if c is not None else None)
-            wanted.append(pk)
         bounds = _resolve_bounds(
-            [k[0] for k in keys], [k[1] for k in keys], stats_list, wanted,
+            [k[0] for k in keys], [k[1] for k in keys], stats_list, packable,
             live,  # dead/padded rows must not widen the spans
         )
+        # The encoding compiles as ONE jitted function per (spec, shapes)
+        # key (K.build_sort_words) instead of an eager op chain per query;
+        # widths quantize so queries with similar key spans share the
+        # compiled encoder. Standalone words: ints fold direction via
+        # order-reversing bitwise not; floats stay NATIVE f64 words (this
+        # TPU toolchain cannot bitcast emulated 64-bit types) with -0.0
+        # normalized, nulls masked before the NaN rank, NaN in a 1-bit
+        # rank field (Spark: NaN greater than +inf), direction by negation.
+        spec = []
+        arrays = []
+        if include_live:
+            spec.append(("L",))
         for (d, v, asc, nf), pk, b in zip(keys, packable, bounds):
             if nf is None:
                 nf = asc
-            width = None
+            hv = v is not None
+            if d.dtype == jnp.bool_:
+                d = d.astype(jnp.int32)
             if pk:
                 vmin, vmax = b
                 if vmax < vmin:  # empty/all-null: constant key, skip
                     continue
-                span = vmax - vmin + 3  # 1..span-2; 0, span-1 for NULL
-                width = max(1, int(span - 1).bit_length())
-            if width is not None and width <= 62:
-                d64 = d.astype(jnp.int64)
-                code = (d64 - vmin + 1) if asc else (vmax - d64 + 1)
-                if v is not None:
-                    code = jnp.where(v, code, 0 if nf else span - 1)
-                packer.add(code, width)
-                continue
-            # standalone word: null rank into the shared stream, then the
-            # value as its own full-width word. Ints fold direction via
-            # order-reversing bitwise not; floats stay NATIVE f64 words
-            # (the canonical kv kernel jit-caches per dtype, and this TPU
-            # toolchain cannot bitcast emulated 64-bit types) with -0.0
-            # normalized, NaN lifted into a 1-bit rank field (Spark: NaN
-            # sorts greater than +inf), and direction folded by negation.
-            if v is not None:
-                packer.add(
-                    jnp.where(v, 1 if nf else 0, 0 if nf else 1).astype(
-                        jnp.int64
-                    ),
-                    1,
-                )
-            if pk:
-                w = d.astype(jnp.int64)
-                if not asc:
-                    w = ~w
-                if v is not None:
-                    w = jnp.where(v, w, 0)
+                span = vmax - vmin + 3  # codes 1..span-2; 0, top for NULL
+                width = K.quantize_width(max(1, int(span - 1).bit_length()))
+                if width <= 62:
+                    spec.append(("i", width, asc, nf, hv))
+                    arrays += [d, jnp.int64(vmin), jnp.int64(vmax)]
+                    if hv:
+                        arrays.append(v)
+                    continue
+                spec.append(("I", asc, nf, hv))
             else:
-                w = d.astype(jnp.float64)
-                if v is not None:
-                    # mask nulls FIRST: a NULL row whose payload happens to
-                    # be NaN (e.g. x/0 with valid=False) must get the same
-                    # nan_rank as every other NULL row
-                    w = jnp.where(v, w, 0.0)
-                w = jnp.where(w == 0.0, 0.0, w)  # -0.0 == 0.0
-                nan = jnp.isnan(w)
-                nan_rank = jnp.where(nan, 1 if asc else 0, 0 if asc else 1)
-                packer.add(nan_rank.astype(jnp.int64), 1)
-                w = jnp.where(nan, 0.0, w)
-                if not asc:
-                    w = -w
-            packer.flush()
-            words.append(w)
-        packer.flush()
-        return words
+                spec.append(("f", asc, nf, hv))
+            arrays.append(d)
+            if hv:
+                arrays.append(v)
+        if not spec:  # every key constant: one trivial live word
+            spec.append(("L",))
+        return list(K.build_sort_words(tuple(spec), live, *arrays))
 
     def _group_words(self, active_cols, live):
         """Word encoding for group-by adjacency (equality only): the sort

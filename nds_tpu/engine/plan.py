@@ -141,6 +141,11 @@ class MaterializedScan(PlanNode):
     table: object = None  # columnar.Table
 
 
+import itertools as _itertools
+
+_fp_serials = _itertools.count()
+
+
 def fingerprint(node: PlanNode) -> str:
     """Stable structural identity of a plan subtree.
 
@@ -159,9 +164,15 @@ def fingerprint(node: PlanNode) -> str:
 
     def emit(v):
         if isinstance(v, MaterializedScan):
-            # a populated table is identity, not structure: never let two
-            # different in-memory tables share a fingerprint
-            t = "none" if v.table is None else str(id(v.table))
+            # a populated table is identity, not structure: tag it with a
+            # monotonic serial (id() values are reused after GC, which
+            # could alias plan-cache entries across statements)
+            if v.table is None:
+                t = "none"
+            else:
+                t = getattr(v.table, "_fp_serial", None)
+                if t is None:
+                    t = v.table._fp_serial = next(_fp_serials)
             out.append(f"MScan:{v.name}:{t}")
         elif isinstance(v, (PlanNode, E.Expr)):
             key = id(v)

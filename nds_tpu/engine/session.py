@@ -248,6 +248,23 @@ class Catalog:
         from .columnar import Column as Col
 
         n_dev = mesh.devices.size
+
+        if jax.process_count() > 1:
+            # multi-process (DCN tier): device_put cannot target
+            # non-addressable devices; build the global array from each
+            # process's local slices of the host copy instead (the
+            # hosts-read-own-chunks ingestion path uses
+            # multihost.shard_rows_across_hosts directly)
+            import numpy as np
+
+            def _put(x, spec):
+                host = np.asarray(x)
+                return jax.make_array_from_callback(
+                    host.shape, spec, lambda idx: host[idx]
+                )
+        else:
+            _put = jax.device_put
+
         cols = {}
         warned = False
         for cname, c in t.columns.items():
@@ -269,9 +286,9 @@ class Catalog:
                         )
             else:
                 spec = NamedSharding(mesh, PS())
-            valid = None if c.valid is None else jax.device_put(c.valid, spec)
+            valid = None if c.valid is None else _put(c.valid, spec)
             cols[cname] = Col(
-                jax.device_put(c.data, spec), c.dtype, valid, c.dictionary,
+                _put(c.data, spec), c.dtype, valid, c.dictionary,
                 c.stats,
             )
         return Table(cols, t.nrows)

@@ -209,6 +209,8 @@ def power_test(params):
         cmd += ["--property_file", cfg["property_path"]]
     if cfg.get("output_path"):
         cmd += ["--output_prefix", cfg["output_path"]]
+    if cfg.get("sub_queries"):
+        cmd += ["--sub_queries", cfg["sub_queries"]]
     _run(cmd)
 
 
@@ -223,6 +225,10 @@ def throughput_test(params, num_streams, first_or_second):
         cfg["report_base_path"],
         "--input_format", params["load_test"].get("warehouse_format", "lakehouse"),
     ]
+    if cfg.get("mode"):
+        cmd += ["--mode", cfg["mode"]]
+    if cfg.get("sub_queries"):
+        cmd += ["--sub_queries", cfg["sub_queries"]]
     _run(cmd)
 
 

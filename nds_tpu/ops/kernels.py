@@ -206,6 +206,100 @@ def fold_sort_key(data, valid, ascending: bool, nulls_first: bool):
     return [null_rank, jnp.where(valid, d, jnp.zeros((), d.dtype))]
 
 
+# -- spec-driven word building ----------------------------------------------
+# Building sort words op-by-op in eager mode costs ~0.6 s of XLA compile
+# per (op, shape) instance on this host — a fresh chain per query. Instead
+# the whole encoding compiles as ONE function per (spec, shapes) key, and
+# field widths are quantized to a small ladder so the same compiled
+# encoder serves every query whose keys have similar spans.
+
+_WIDTH_LADDER = (2, 3, 4, 6, 8, 11, 16, 22, 32, 44, 62)
+
+
+def quantize_width(w: int) -> int:
+    for q in _WIDTH_LADDER:
+        if w <= q:
+            return q
+    return 63  # force standalone
+
+
+@partial(jax.jit, static_argnames=("spec",))
+def build_sort_words(spec, live, *arrays):
+    """Encode sort keys into words under a STATIC spec.
+
+    spec: tuple of field descriptors, major->minor:
+      ("L",)                 — live bit from `live` (dead rows last)
+      ("i", width, asc, nf, has_valid) — bounded int field, mixed-radix
+            packed; consumes data, vmin, vmax [, valid] from `arrays`
+      ("I", asc, nf, has_valid)        — unbounded int, standalone word;
+            consumes data [, valid]
+      ("f", asc, nf, has_valid)        — float: 1-bit NaN rank into the
+            shared stream + standalone f64 word; consumes data [, valid]
+    Returns the word tuple for sort_by_words / group_by_words."""
+    it = iter(arrays)
+    words = []
+    cur = {"w": None, "bits": 0}
+
+    def flush():
+        if cur["w"] is not None:
+            words.append(cur["w"])
+        cur["w"] = None
+        cur["bits"] = 0
+
+    def add(code, width):
+        if cur["bits"] + width > 62:
+            flush()
+        code = code & ((1 << width) - 1)  # clamp dead-row garbage
+        cur["w"] = (
+            code if cur["w"] is None else (cur["w"] << width) | code
+        )
+        cur["bits"] += width
+
+    for field in spec:
+        kind = field[0]
+        if kind == "L":
+            add(jnp.where(live, 0, 1).astype(I64), 1)
+            continue
+        if kind == "i":
+            _, width, asc, nf, hv = field
+            d = next(it).astype(I64)
+            vmin = next(it)
+            vmax = next(it)
+            v = next(it) if hv else None
+            code = (d - vmin + 1) if asc else (vmax - d + 1)
+            if v is not None:
+                # null first -> 0; null last -> top code (clamped by add)
+                code = jnp.where(v, code, 0 if nf else (1 << width) - 1)
+            add(code, width)
+            continue
+        _, asc, nf, hv = field
+        d = next(it)
+        v = next(it) if hv else None
+        if v is not None:
+            add(jnp.where(v, 1 if nf else 0, 0 if nf else 1).astype(I64), 1)
+        if kind == "I":
+            w = d.astype(I64)
+            if not asc:
+                w = ~w
+            if v is not None:
+                w = jnp.where(v, w, 0)
+        else:  # float
+            w = d.astype(jnp.float64)
+            if v is not None:
+                w = jnp.where(v, w, 0.0)  # mask nulls BEFORE the NaN rank
+            w = jnp.where(w == 0.0, 0.0, w)  # -0.0 == 0.0
+            nan = jnp.isnan(w)
+            add(jnp.where(nan, 1 if asc else 0, 0 if asc else 1).astype(I64),
+                1)
+            w = jnp.where(nan, 0.0, w)
+            if not asc:
+                w = -w
+        flush()
+        words.append(w)
+    flush()
+    return tuple(words)
+
+
 def key_words(keys, live_mask):
     """Generic word encoding for (data, valid, ascending, nulls_first) key
     tuples: a leading live word (dead rows last), then per key a 1-bit

@@ -34,9 +34,14 @@ def load_output(path: str, fmt: str):
     return pads.dataset(path, format=fmt).to_table()
 
 
-def collect_results(table, ignore_ordering: bool):
+def collect_results(table, ignore_ordering: bool, batch_rows: int = 8192):
     """Rows as python lists, optionally sorted on non-float columns first
-    (reference: collect_results :113-141)."""
+    (reference: collect_results :113-141, which streams via
+    toLocalIterator). Python row objects are materialized one record batch
+    at a time, so memory stays bounded at SF>=100 validation scale — the
+    sort (when requested) happens in compact Arrow columnar form, never as
+    Python lists."""
+
     import pyarrow.types as pat
 
     if ignore_ordering:
@@ -45,8 +50,19 @@ def collect_results(table, ignore_ordering: bool):
         ]
         floats = [f.name for f in table.schema if pat.is_floating(f.type)]
         table = table.sort_by([(c, "ascending") for c in non_float + floats])
-    cols = [table.column(name).to_pylist() for name in table.schema.names]
-    return (list(row) for row in zip(*cols)) if cols else iter([])
+
+    def gen():
+        for batch in table.to_batches(max_chunksize=batch_rows):
+            cols = [
+                batch.column(i).to_pylist()
+                for i in range(batch.num_columns)
+            ]
+            if not cols:
+                continue
+            for row in zip(*cols):
+                yield list(row)
+
+    return gen()
 
 
 def compare(expected, actual, epsilon=0.00001) -> bool:

@@ -156,3 +156,73 @@ def test_full_bench_end_to_end(data_dir, tmp_path, monkeypatch):
         params[phase]["skip"] = True
     metrics2 = FB.run_full_bench(params)
     assert metrics2["perf_metric"] == metrics["perf_metric"]
+
+
+def test_full_bench_real_generated_streams(data_dir, tmp_path, monkeypatch):
+    """The pipeline with REAL generated streams (VERDICT r3 #4): stream
+    generation runs for real (skip=False), and the power + throughput
+    phases consume the generated stream files (a fast template subset via
+    sub_queries), so stream-file -> power-driver integration (template
+    ordering, the two-part query14/23/24/39 split) is exercised outside
+    the timed bench (reference: nds/nds_bench.py:249-304)."""
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    num_streams = 3
+    for i in (1, 2):
+        upd = f"{data_dir}_update{i}"
+        if not os.path.isdir(upd):
+            subprocess.run(
+                [sys.executable, "-m", "nds_tpu.cli.gen_data", "--scale",
+                 "0.01", "--parallel", "2", "--data_dir", upd,
+                 "--update", str(i), "--overwrite_output"],
+                check=True, capture_output=True, cwd=REPO,
+            )
+    subset = ("query3,query7,query12,query15,query19,query26,query42,"
+              "query52,query96,query14_part1")
+    params = {
+        "data_gen": {
+            "scale_factor": 0.01, "parallel": 2,
+            "raw_data_path": data_dir, "skip": True,
+        },
+        "load_test": {
+            "output_path": str(tmp_path / "warehouse"),
+            "warehouse_format": "lakehouse",
+            "report_path": str(tmp_path / "load.txt"),
+            "skip": False,
+        },
+        "generate_query_stream": {
+            "num_streams": num_streams,
+            "query_template_dir": None,
+            "stream_output_path": str(tmp_path / "streams"),
+            "skip": False,  # REAL stream generation under test
+        },
+        "power_test": {
+            "report_path": str(tmp_path / "power.csv"),
+            "property_path": None,
+            "output_path": None,
+            "sub_queries": subset,
+            "skip": False,
+        },
+        "throughput_test": {
+            "report_base_path": str(tmp_path / "throughput"),
+            "sub_queries": subset,
+            "skip": False,
+        },
+        "maintenance_test": {
+            "maintenance_report_base_path": str(tmp_path / "maintenance"),
+            "maintenance_queries": "LF_SS,DF_SS",
+            "skip": False,
+        },
+        "metrics_report_path": str(tmp_path / "metrics.csv"),
+    }
+    monkeypatch.chdir(REPO)
+    metrics = FB.run_full_bench(params)
+    assert metrics["perf_metric"] > 0
+    # the generated stream files are real 99-template permutations (the
+    # two-part templates split into _part1/_part2 at parse time)
+    stream0 = (tmp_path / "streams" / "query_0.sql").read_text()
+    assert stream0.count("-- start query") == 99
+    assert "query14_part1" not in stream0  # parts carry the template name
+    # power consumed the generated stream: its log holds the subset queries
+    power_log = (tmp_path / "power.csv").read_text()
+    for q in ("query3", "query96", "query14_part1"):
+        assert q in power_log
