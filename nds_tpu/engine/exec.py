@@ -36,6 +36,11 @@ class ExecError(Exception):
     pass
 
 
+# dev tracing: set to a list to record (inclusive_seconds, node_type,
+# summary) per executed plan node (used by tools/trace_query.py)
+TRACE_NODES = None
+
+
 def _resolve_bounds(datas, valids, stats_list, wanted, live):
     """(vmin, vmax) per column: from cached ColStats when present, else one
     batched min/max kernel + a single device->host transfer for ALL missing
@@ -126,7 +131,20 @@ class Executor:
                 self._cte_cache[key] = hit
                 return hit
         m = getattr(self, f"_exec_{type(node).__name__.lower()}")
-        out = m(node)
+        if TRACE_NODES is not None:
+            import time as _time
+
+            t0 = _time.perf_counter()
+            out = m(node)
+            # INCLUSIVE wall time (children execute inside this frame);
+            # repeated visits are cte-cache dict hits, so each node records
+            # once per executor
+            TRACE_NODES.append(
+                (_time.perf_counter() - t0, type(node).__name__,
+                 P.explain(node).splitlines()[0][:90])
+            )
+        else:
+            out = m(node)
         self._cte_cache[key] = out
         if cache is not None:
             cache.put(self._fp(node), out)
@@ -1395,7 +1413,7 @@ class Executor:
         if pkeys:
             sorted_p = [w[order] for w in pwords]
             flags = K._word_flags(sorted_p)
-            gid = jnp.cumsum(flags.astype(jnp.int32)) - 1
+            gid = K.fast_cumsum(flags.astype(jnp.int32)) - 1
             nlive = child.nrows
             ng = int(gid[nlive - 1]) + 1 if nlive else 0
         else:
@@ -1414,11 +1432,11 @@ class Executor:
             else:
                 # order-group boundaries within partitions (ties share a rank)
                 oflags = K._word_flags([gid] + sorted_ow)
-                ogid = jnp.cumsum(oflags.astype(jnp.int32)) - 1
+                ogid = K.fast_cumsum(oflags.astype(jnp.int32)) - 1
                 part_first = K.segment_starts(gid, gcap)
                 if fn == "dense_rank":
                     # count of order-group starts since the partition start
-                    cums = jnp.cumsum(oflags.astype(jnp.int32))
+                    cums = K.fast_cumsum(oflags.astype(jnp.int32))
                     base = cums[jnp.clip(part_first, 0, child.cap - 1)]
                     vals = cums - base[gid] + 1
                 else:
@@ -1469,33 +1487,24 @@ class Executor:
 
         if fn in ("min", "max"):
             # running min/max (q51: `rows unbounded preceding..current row`)
-            # via a segmented scan: flag-carrying associative operator resets
-            # at partition starts, so one lax.associative_scan covers all
-            # partitions without a loop
+            # via rank-transform + native cummax (exact; see
+            # K.segmented_running_extreme — a flag-carrying
+            # lax.associative_scan compiled for minutes at fact shapes)
             if frame not in (
                 (("unbounded", "preceding"), ("current", None)),
                 None,
             ):
                 raise ExecError(f"window {fn} over frame {frame}")
-            ext = K._extreme(sdata.dtype, is_max=(fn == "min"))
-            x = jnp.where(w, sdata, ext)
-            n = x.shape[0]
-            starts = jnp.zeros(n, bool).at[0].set(True)
-            starts = starts.at[1:].max(gid[1:] != gid[:-1])
-            combine = jnp.minimum if fn == "min" else jnp.maximum
-
-            def op(a, b):
-                fa, va = a
-                fb, vb = b
-                return fa | fb, jnp.where(fb, vb, combine(va, vb))
-
-            _, scanned = jax.lax.associative_scan(op, (starts, x))
+            sorted_vals, rank = K.value_rank(sdata)
+            scanned = K.segmented_running_extreme(
+                sorted_vals, rank, gid, w, fn == "max"
+            )
             cnt_run = _segment_cumsum(w.astype(jnp.int64), gid)
             if frame is None:
                 # RANGE default: current row's peers (equal order keys) are
                 # in-frame, so read the running value at the peer-group end
                 oflags = K._word_flags([gid] + sorted_ow)
-                ogid = jnp.cumsum(oflags.astype(jnp.int32)) - 1
+                ogid = K.fast_cumsum(oflags.astype(jnp.int32)) - 1
                 n_og = int(ogid[child.nrows - 1]) + 1 if child.nrows else 1
                 ogcap = bucket_cap(max(n_og, 1))
                 og_first = K.segment_starts(ogid, ogcap)
@@ -1522,7 +1531,7 @@ class Executor:
                 # RANGE: current row's peers (equal order keys) are included,
                 # so take the cumulative value at the END of the peer group
                 oflags = K._word_flags([gid] + sorted_ow)
-                ogid = jnp.cumsum(oflags.astype(jnp.int32)) - 1
+                ogid = K.fast_cumsum(oflags.astype(jnp.int32)) - 1
                 n_og = int(ogid[child.nrows - 1]) + 1 if child.nrows else 1
                 ogcap = bucket_cap(max(n_og, 1))
                 og_first = K.segment_starts(ogid, ogcap)
@@ -1737,15 +1746,14 @@ class Executor:
 
 def _segment_cumsum(x, gid):
     """Cumulative sum within segments (gid sorted ascending)."""
-    total = jnp.cumsum(x)
+    total = K.fast_cumsum(x)
     n = x.shape[0]
     idx = jnp.arange(n, dtype=jnp.int32)
     is_start = jnp.zeros(n, bool).at[0].set(True).at[1:].max(gid[1:] != gid[:-1])
-    # propagate each row's own segment-start index forward (max-scan over a
-    # non-decreasing quantity, safe regardless of x's sign)
-    seg_start = jax.lax.associative_scan(
-        jnp.maximum, jnp.where(is_start, idx, 0)
-    )
+    # propagate each row's own segment-start index forward. Native cummax,
+    # NOT associative_scan: the generic log-depth scan construction
+    # compiles for minutes at fact shapes on this toolchain.
+    seg_start = K.fast_cummax(jnp.where(is_start, idx, 0))
     base = jnp.where(
         seg_start > 0, total[jnp.maximum(seg_start - 1, 0)], jnp.zeros((), total.dtype)
     )

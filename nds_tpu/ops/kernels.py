@@ -68,6 +68,50 @@ def hash_columns(cols, valids) -> jnp.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# Cumulative ops
+#
+# XLA:TPU compile time for cumulative ops scales with the scanned-axis
+# LENGTH (flat cumsum i64 at 2^20: ~16 s; cummax: ~25 s on this host). The
+# blocked (recursive) form — short inner scans over a (B, T) reshape plus
+# a scan of the block totals — compiles in ~1-2 s, so every engine
+# cumulative routes through these. Exact for integers; float sums are
+# reassociated block-wise (final-ulp differences vs a flat scan, within
+# the validator's relative-epsilon contract).
+# ---------------------------------------------------------------------------
+
+_CUM_BLOCK = 512
+
+
+def fast_cumsum(x: jnp.ndarray) -> jnp.ndarray:
+    """Exact inclusive prefix sum, compile-friendly on TPU."""
+    n = x.shape[0]
+    if n < 2 * _CUM_BLOCK or n % _CUM_BLOCK:
+        return jnp.cumsum(x)
+    b = n // _CUM_BLOCK
+    y = jnp.cumsum(x.reshape(b, _CUM_BLOCK), axis=1)
+    off = jnp.concatenate(
+        [jnp.zeros(1, y.dtype), fast_cumsum(y[:, -1])[:-1]]
+    )
+    return (y + off[:, None]).reshape(-1)
+
+
+def fast_cummax(x: jnp.ndarray) -> jnp.ndarray:
+    """Exact inclusive prefix max, compile-friendly on TPU."""
+    n = x.shape[0]
+    if n < 2 * _CUM_BLOCK or n % _CUM_BLOCK:
+        return jax.lax.cummax(x)
+    b = n // _CUM_BLOCK
+    y = jax.lax.cummax(x.reshape(b, _CUM_BLOCK), axis=1)
+    m = fast_cummax(y[:, -1])
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        lo = jnp.full((1,), jnp.iinfo(x.dtype).min, x.dtype)
+    else:
+        lo = jnp.full((1,), -jnp.inf, x.dtype)
+    off = jnp.concatenate([lo, m[:-1]])
+    return jnp.maximum(y, off[:, None]).reshape(-1)
+
+
+# ---------------------------------------------------------------------------
 # Compaction (filter)
 # ---------------------------------------------------------------------------
 
@@ -82,7 +126,7 @@ def _compact_full(mask: jnp.ndarray) -> jnp.ndarray:
     compile). With compiles costing seconds per shape on a 1-core host,
     (shape x out_cap) kernel proliferation was a top cold-start cost."""
     n = mask.shape[0]
-    pos = jnp.where(mask, jnp.cumsum(mask.astype(jnp.int32)) - 1, n)
+    pos = jnp.where(mask, fast_cumsum(mask.astype(jnp.int32)) - 1, n)
     return (
         jnp.zeros(n, jnp.int32)
         .at[pos]
@@ -169,7 +213,7 @@ def group_by_words(words, live_mask, nlive=None):
     order = sort_by_words(words)
     sorted_words = [w[order] for w in words]
     flags = _word_flags(sorted_words)
-    gid = jnp.cumsum(flags.astype(jnp.int32)) - 1
+    gid = fast_cumsum(flags.astype(jnp.int32)) - 1
     if nlive is None:
         nlive = mask_count(live_mask)
     if nlive == 0:
@@ -572,7 +616,7 @@ def direct_gid(keys, valids, mins, ranges, live):
 def occupancy_map(gid, live, domain_cap):
     """occupied cell mask + dense renumbering (cell -> 0..ngroups-1)."""
     occ = jnp.zeros(domain_cap, bool).at[gid].max(live, mode="drop")
-    dense = jnp.cumsum(occ.astype(jnp.int32)) - 1
+    dense = fast_cumsum(occ.astype(jnp.int32)) - 1
     return occ, dense
 
 
@@ -591,11 +635,59 @@ def segment_starts(gid, num_segments):
 
 @partial(jax.jit, static_argnames=())
 def running_position(gid):
-    """0-based position of each sorted row within its segment."""
+    """0-based position of each sorted row within its segment.
+
+    lax.cummax, NOT lax.associative_scan: the generic log-depth scan
+    construction compiles for minutes at fact shapes on this toolchain,
+    while the native cumulative ops compile like cumsum."""
     n = gid.shape[0]
     idx = jnp.arange(n, dtype=jnp.int32)
     first = jnp.zeros(n, dtype=bool).at[0].set(True)
     first = first.at[1:].max(gid[1:] != gid[:-1])
     start_of_own = jnp.where(first, idx, 0)
-    seg_start = jax.lax.associative_scan(jnp.maximum, start_of_own)
+    seg_start = fast_cummax(start_of_own)
     return idx - seg_start
+
+
+def value_rank(x):
+    """(sorted_values, rank): each row's position in the ascending global
+    sort of its value, via the canonical kv kernel. Floats sort natively
+    (f64 instance; -0.0 normalized, NaN last == Spark's NaN-greatest)."""
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        key = x.astype(jnp.float64)
+        key = jnp.where(key == 0.0, 0.0, key)
+    else:
+        key = x.astype(I64)
+    p = _kv_sort_perm(key)
+    n = x.shape[0]
+    rank = (
+        jnp.zeros(n, jnp.int32).at[p].set(jnp.arange(n, dtype=jnp.int32))
+    )
+    return x[p], rank
+
+
+@partial(jax.jit, static_argnames=("is_max",))
+def segmented_running_extreme(vals_sorted_by_rank, rank, gid, weight,
+                              is_max):
+    """Running min/max within contiguous segments (gid ascending), exact
+    for any dtype, without a generic associative scan (whose log-depth
+    construction compiles for minutes at fact shapes on this toolchain).
+
+    `rank`/`vals_sorted_by_rank` come from value_rank. y = gid * n + rank
+    is gid-major monotone, so a native cummax over y can never leak an
+    earlier segment's entry (rank < n), and mapping the winning rank back
+    through the sorted values recovers the exact running extreme.
+    Zero-weight rows get rank -1 (never win); a row whose segment prefix
+    is all zero-weight gathers an arbitrary value — callers mask those
+    via the running weight count."""
+    n = jnp.int64(rank.shape[0])
+    r = rank.astype(I64)
+    if not is_max:
+        r = n - 1 - r  # running min == running max of reversed ranks
+    r = jnp.where(weight, r, -1)
+    y = gid.astype(I64) * n + r
+    cm = fast_cummax(y)
+    win = cm - gid.astype(I64) * n
+    if not is_max:
+        win = n - 1 - win
+    return vals_sorted_by_rank[jnp.clip(win, 0, n - 1)]

@@ -31,7 +31,7 @@ for name in ("jax._src.interpreters.pxla", "jax._src.dispatch",
     lg.setLevel(logging.DEBUG)
     lg.addHandler(Handler())
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from nds_tpu.engine.session import Session  # noqa: E402
 from nds_tpu.schema import get_schemas  # noqa: E402

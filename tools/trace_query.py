@@ -1,0 +1,46 @@
+"""Dev tool: per-plan-node steady-state timing for one query on the chip.
+
+Usage: python tools/trace_query.py query4 [query14_part2 ...]
+Runs each query twice (cold then traced steady) and prints the slowest
+plan nodes with INCLUSIVE wall time.
+"""
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from nds_tpu.engine import exec as X
+from nds_tpu.engine.session import Session
+from nds_tpu.schema import get_schemas
+from nds_tpu.datagen.query_streams import generate_streams
+from nds_tpu.power import gen_sql_from_stream
+
+DATA_DIR = os.environ.get("NDS_BENCH_DATA", "/tmp/nds_bench_sf1.0")
+
+with tempfile.TemporaryDirectory() as d:
+    generate_streams(d, 1, 1, rngseed=19620718)
+    queries = gen_sql_from_stream(os.path.join(d, "query_0.sql"))
+
+sess = Session()
+sess.conf["engine.plan_cache"] = "off"
+for t, schema in get_schemas().items():
+    p = os.path.join(DATA_DIR, t)
+    if os.path.isdir(p):
+        sess.register_csv_dir(t, p, schema)
+
+for qname in sys.argv[1:]:
+    r = sess.run_script(queries[qname])  # warm compile caches
+    if r is not None:
+        r.collect()
+    X.TRACE_NODES = trace = []
+    t0 = time.perf_counter()
+    r = sess.run_script(queries[qname])
+    if r is not None:
+        r.collect()
+    total = time.perf_counter() - t0
+    X.TRACE_NODES = None
+    print(f"\n=== {qname}: steady {total:.2f}s, {len(trace)} nodes ===")
+    for secs, typ, desc in sorted(trace, reverse=True)[:18]:
+        print(f"  {secs:7.3f}s  {typ:12s} {desc}")
