@@ -112,12 +112,40 @@ class Column:
         return replace(self.stats, unique=False)
 
 
-@dataclass
 class Table:
-    """A named collection of equal-capacity columns with a live row count."""
+    """A named collection of equal-capacity columns with a live row count.
 
-    columns: dict  # name -> Column (insertion-ordered)
-    nrows: int
+    Deferred compaction: `live` (when set) is an explicit per-row liveness
+    mask — filtered/joined rows stay in place instead of being packed to
+    the front, and `nrows` may be a 0-d device scalar that only crosses to
+    the host on first access. Device->host syncs cost ~90 ms each on the
+    bench tunnel, so producers queue the count asynchronously and most
+    consumers (masks, group-by, joins, sorts) never force it."""
+
+    __slots__ = ("columns", "_nrows", "live", "_packed")
+
+    def __init__(self, columns: dict, nrows, live=None):
+        self.columns = columns  # name -> Column (insertion-ordered)
+        self._nrows = nrows  # host int or 0-d device array (lazy)
+        self.live = live  # None (first nrows rows live) or bool[cap]
+        self._packed = None  # memoized compacted() result
+
+    @property
+    def nrows(self) -> int:
+        if not isinstance(self._nrows, int):
+            self._nrows = int(self._nrows)  # device sync on first need
+        return self._nrows
+
+    @property
+    def nrows_known(self):
+        """The live row count if already on the host, else None."""
+        return self._nrows if isinstance(self._nrows, int) else None
+
+    @property
+    def nrows_lazy(self):
+        """The live row count without forcing a device sync (host int or
+        0-d device array); pass through when constructing derived tables."""
+        return self._nrows
 
     @property
     def cap(self) -> int:
@@ -135,16 +163,47 @@ class Table:
         return self.columns[name]
 
     def select(self, names) -> "Table":
-        return Table({n: self.columns[n] for n in names}, self.nrows)
+        return Table(
+            {n: self.columns[n] for n in names}, self._nrows, self.live
+        )
 
     def rename(self, mapping: dict) -> "Table":
         return Table(
-            {mapping.get(n, n): c for n, c in self.columns.items()}, self.nrows
+            {mapping.get(n, n): c for n, c in self.columns.items()},
+            self._nrows,
+            self.live,
         )
 
     def row_mask(self) -> jnp.ndarray:
-        """Bool mask of live rows (True for index < nrows)."""
-        return jnp.arange(self.cap, dtype=jnp.int32) < self.nrows
+        """Bool mask of live rows."""
+        if self.live is not None:
+            return self.live
+        return jnp.arange(self.cap, dtype=jnp.int32) < self._nrows
+
+    def compacted(self) -> "Table":
+        """Pack live rows to the front (drops the mask). Reuses the count
+        already queued in _nrows (no extra reduce/sync) and memoizes, so a
+        masked table shared by several consumers compacts once."""
+        if self.live is None:
+            return self
+        if self._packed is not None:
+            return self._packed
+        from ..ops import kernels as K
+
+        count = self.nrows
+        cap = bucket_cap(max(count, 1))
+        idx = K.compact_indices(self.live, cap)
+        cols = {}
+        for name, c in self.columns.items():
+            cols[name] = Column(
+                c.data[idx],
+                c.dtype,
+                None if c.valid is None else c.valid[idx],
+                c.dictionary,
+                c.subset_stats(),
+            )
+        self._packed = Table(cols, count)
+        return self._packed
 
 
 # ---------------------------------------------------------------------------
@@ -328,6 +387,7 @@ def column_to_arrow(col: Column, nrows: int, host=None) -> pa.Array:
 
 
 def table_to_arrow(table: Table) -> pa.Table:
+    table = table.compacted()  # deferred-compaction tables pack here
     # one batched device->host round trip for every buffer (each blocking
     # np.asarray would otherwise pay a full tunnel round trip per column)
     flat = []

@@ -175,14 +175,16 @@ class Executor:
             cols[name] = ev.eval(e)
         if not cols:
             return Table({}, child.nrows)
-        return Table(cols, child.nrows)
+        # deferred-compaction mask rides through (masked rows hold garbage
+        # expression values, which stay masked)
+        return Table(cols, child.nrows_lazy, live=child.live)
 
     def _exec_filter(self, node: P.Filter) -> Table:
         child = self.execute(node.child)
-        return self._compact(child, self._predicate_mask(child, node.predicate))
+        return self._masked(child, self._predicate_mask(child, node.predicate))
 
     def _exec_limit(self, node: P.Limit) -> Table:
-        child = self.execute(node.child)
+        child = self.execute(node.child).compacted()
         n = min(node.n, child.nrows)
         cap = bucket_cap(n)
         cols = {
@@ -198,8 +200,8 @@ class Executor:
         return Table(cols, n)
 
     def _exec_sort(self, node: P.Sort) -> Table:
-        child = self.execute(node.child)
-        if child.nrows == 0:
+        child = self._pack_sparse(self.execute(node.child))
+        if child.nrows_known == 0:
             return child
         ev = self._evaluator(child)
         keys = []
@@ -222,7 +224,7 @@ class Executor:
         if dist is not None:
             return dist
         order = K.sort_by_words(words)
-        return self._take(child, order, child.nrows)
+        return self._take(child, order, child.nrows_lazy)
 
     # -- sort-key word encoding -------------------------------------------
     # Every ordering in the engine (ORDER BY, group-by adjacency, window
@@ -402,7 +404,7 @@ class Executor:
 
     def _exec_distinct(self, node: P.Distinct) -> Table:
         child = self.execute(node.child)
-        if child.nrows == 0:
+        if child.nrows_known == 0:
             return child
         return self._distinct_table(child)
 
@@ -458,7 +460,7 @@ class Executor:
             mask = present & dl.row_mask()
         else:
             mask = ~present & dl.row_mask()
-        return self._compact(dl, mask)
+        return self._masked(dl, mask)
 
     # ------------------------------------------------------------------
     def _exec_join(self, node: P.Join) -> Table:
@@ -488,42 +490,18 @@ class Executor:
         return self._multijoin_greedy(node, tables, current, edges, merged, group, n)
 
     def _execute_relations_batched(self, relations):
-        """Execute a MultiJoin's relations, folding the host sync of every
-        top-level Filter into ONE device->host round trip.
+        """Execute a MultiJoin's relations and materialize their live
+        counts with ONE device->host round trip.
 
-        Eager compaction needs each filter's live count to size its output
-        bucket; executing relations one-by-one pays a full tunnel round trip
-        per filtered dimension (~70-130 ms each on a remote chip). Here all
-        predicate masks are dispatched first, their counts fetched with a
-        single batched jax.device_get, then the compactions sized and issued."""
-        deferred = []  # (slots, plan_node, child_table, mask)
-        deferred_by_id = {}  # id(node) -> deferred entry (dedupe repeats)
-        tables = []
-        for r in relations:
-            if isinstance(r, P.Filter) and id(r) not in self._cte_cache:
-                tables.append(None)
-                if id(r) in deferred_by_id:  # same Filter object repeated
-                    deferred_by_id[id(r)][0].append(len(tables) - 1)
-                    continue
-                child = self.execute(r.child)
-                mask = self._predicate_mask(child, r.predicate)
-                entry = ([len(tables) - 1], r, child, mask)
-                deferred.append(entry)
-                deferred_by_id[id(r)] = entry
-            else:
-                tables.append(self.execute(r))
-        if deferred:
-            counts = jax.device_get(
-                [jnp.sum(m) for (_, _, _, m) in deferred]
-            )
-            for (slots, r, child, mask), cnt in zip(deferred, counts):
-                cnt = int(cnt)
-                cap = bucket_cap(max(cnt, 1))
-                idx = K.compact_indices(mask, cap)
-                out = self._take(child, idx, cnt)
-                self._cte_cache[id(r)] = out  # same memoization as execute()
-                for slot in slots:
-                    tables[slot] = out
+        Filters produce deferred-compaction tables whose counts are queued
+        asynchronously; the greedy join-order heuristic below needs host
+        integers, so all still-lazy counts batch into a single
+        jax.device_get instead of paying ~90 ms per relation."""
+        tables = [self.execute(r) for r in relations]
+        lazy = [t for t in tables if t.nrows_known is None]
+        if lazy:
+            for t, v in zip(lazy, jax.device_get([t.nrows_lazy for t in lazy])):
+                t._nrows = int(v)
         return tables
 
     def _multijoin_greedy(self, node, tables, current, edges, merged, group, n):
@@ -573,10 +551,23 @@ class Executor:
         return out
 
     # ------------------------------------------------------------------
+    def _pack_sparse(self, t: Table) -> Table:
+        """Compact a deferred-compaction table whose live fraction is small:
+        sort/hash consumers scale with CAP, so a 5k-of-131k masked build
+        side would pay 26x its packed cost. The count is usually already
+        materialized (or long since queued), so this rarely blocks."""
+        if t.live is None:
+            return t
+        if t.nrows < max(t.cap // 8, 1024):
+            return t.compacted()
+        return t
+
     def _join(self, left, right, kind, left_keys, right_keys, residual,
               mark_name=None):
         if kind == "cross":
             return self._cross_join(left, right)
+        left = self._pack_sparse(left)
+        right = self._pack_sparse(right)
         if kind == "right":
             # swap before any matching so the residual is preserved
             return self._join(right, left, "left", right_keys, left_keys, residual)
@@ -614,9 +605,9 @@ class Executor:
             if kind == "mark":
                 out_cols = dict(left.columns)
                 out_cols[mark_name] = Column(present, BOOL)
-                return Table(out_cols, left.nrows)
+                return Table(out_cols, left.nrows_lazy, live=left.live)
             mask = (present if kind == "semi" else ~present) & llive
-            return self._compact(left, mask)
+            return self._masked(left, mask)
 
         count = K.mask_count(ok)
         out_cap = bucket_cap(max(count, 1))
@@ -628,7 +619,7 @@ class Executor:
             pair = self._pair_table(left, right, pli, pri, count, rnull=None)
             pmask = self._predicate_mask(pair, residual)
             if kind == "inner":
-                return self._compact(pair, pmask)
+                return self._masked(pair, pmask)
             # outer joins: surviving pairs only count as matches. Scatter with
             # max, not set: sel's padding duplicates index 0 and a plain set
             # could clobber candidate 0's True with a padded False.
@@ -741,15 +732,29 @@ class Executor:
             if kind == "mark":
                 out_cols = dict(left.columns)
                 out_cols[mark_name] = Column(matched, BOOL)
-                return Table(out_cols, left.nrows)
+                return Table(out_cols, left.nrows_lazy, live=left.live)
             mask = (matched if kind == "semi" else ~matched) & llive
-            return self._compact(left, mask)
+            return self._masked(left, mask)
         if kind == "inner":
-            count = K.mask_count(matched)
-            sel = K.compact_indices(matched, bucket_cap(max(count, 1)))
-            pair = self._pair_table(left, right, sel, ri[sel], count, rnull=None)
+            # masked left-aligned output: no count sync, no compaction
+            # gathers — the probe result IS the pair table (matched rows
+            # live in place, right columns gathered alongside)
+            out_cols = dict(left.columns)
+            ri_safe = jnp.where(matched, ri, 0)
+            for name, c in right.columns.items():
+                valid = None if c.valid is None else c.valid[ri_safe]
+                out_cols[name] = Column(
+                    c.data[ri_safe], c.dtype, valid, c.dictionary,
+                    c.gather_stats(),
+                )
+            pair = Table(
+                dict(out_cols), jnp.sum(matched, dtype=jnp.int32),
+                live=matched,
+            )
             if residual is not None:
-                return self._compact(pair, self._predicate_mask(pair, residual))
+                return self._masked(
+                    pair, self._predicate_mask(pair, residual)
+                )
             return pair
         # left join: left-aligned output, unmatched rows null on the right
         out_cols = dict(left.columns)
@@ -760,7 +765,7 @@ class Executor:
                 c.data[ri_safe], c.dtype, valid & matched, c.dictionary,
                 c.gather_stats(),
             )
-        return Table(out_cols, left.nrows)
+        return Table(out_cols, left.nrows_lazy, live=left.live)
 
     # -- distributed fact-fact hash join ---------------------------------
     # When both inner-join inputs are large under a mesh, neither fits the
@@ -956,6 +961,9 @@ class Executor:
         return Table(cols, nrows)
 
     def _cross_join(self, left, right):
+        # position arithmetic below assumes packed rows
+        left = left.compacted()
+        right = right.compacted()
         ln, rn = left.nrows, right.nrows
         total = ln * rn
         cap = bucket_cap(max(total, 1))
@@ -984,18 +992,12 @@ class Executor:
         return out
 
     def _agg_input(self, node: P.Aggregate):
-        """Fuse a directly-nested Filter into the aggregation as a live
-        mask instead of materializing the compacted filter output. Saves
-        the count sync + full-width gather per aggregate-over-filter —
+        """Aggregation input as (table, live mask, known row count|None).
+        Filters/dense joins produce deferred-compaction tables, so e.g.
         the q9 shape (15 scalar subqueries, each a global aggregate over a
-        filtered fact scan) runs entirely async on device this way."""
-        ch = node.child
-        if isinstance(ch, P.Filter) and id(ch) not in self._cte_cache:
-            base = self.execute(ch.child)
-            mask = self._predicate_mask(base, ch.predicate)
-            return base, mask, None
-        t = self.execute(ch)
-        return t, t.row_mask(), t.nrows
+        filtered fact scan) runs entirely async on device."""
+        t = self.execute(node.child)
+        return t, t.row_mask(), t.nrows_known
 
     def _aggregate_once(self, key_items, agg_items, subset, child, live,
                         nlive):
@@ -1379,7 +1381,7 @@ class Executor:
         out_cols = dict(child.columns)
         for wf, name in node.fns:
             out_cols[name] = self._eval_window(child, wf)
-        return Table(out_cols, child.nrows)
+        return Table(out_cols, child.nrows_lazy, live=child.live)
 
     def _eval_window(self, child: Table, wf: E.WindowFn) -> Column:
         ev = self._evaluator(child)
@@ -1656,7 +1658,9 @@ class Executor:
                 if hit is not None:
                     self._scalar_cache[key] = hit
                     return hit
-            t = self.execute(e.plan)
+            # the plan may yield a deferred-compaction table whose single
+            # live row is NOT at index 0 — pack before slicing
+            t = self.execute(e.plan).compacted()
             col = t.columns[e.out_name]
             if t.nrows == 0:
                 self._scalar_cache[key] = (None, col.dtype, col.dictionary)
@@ -1680,6 +1684,16 @@ class Executor:
                 )
         return self._scalar_cache[key]
 
+    def _masked(self, table: Table, mask) -> Table:
+        """Deferred compaction: keep rows in place under a live mask, with
+        the count queued asynchronously (device->host syncs cost ~90 ms on
+        the bench tunnel; a full compaction also pays one gather per
+        column). Downstream operators consume row_mask() directly; packing
+        happens lazily at collect()/limit via Table.compacted()."""
+        return Table(
+            dict(table.columns), jnp.sum(mask, dtype=jnp.int32), live=mask
+        )
+
     def _compact(self, table: Table, mask) -> Table:
         count = K.mask_count(mask)
         cap = bucket_cap(max(count, 1))
@@ -1701,6 +1715,7 @@ class Executor:
         return Table(cols, nrows)
 
     def _distinct_table(self, t: Table) -> Table:
+        t = self._pack_sparse(t)
         live = t.row_mask()
         words = self._group_words(list(t.columns.values()), live)
         order, gid, ng = K.group_by_words(words, live, t.nrows)
@@ -1710,14 +1725,23 @@ class Executor:
         return self._take(t, rows, ng)
 
     def _concat(self, a: Table, b: Table) -> Table:
+        """Masked concatenation: columns append at full capacity (padded to
+        a power-of-two bucket) under a combined live mask — no repacking
+        gathers and no count syncs (union chains were paying both per
+        level)."""
         names = list(a.columns)
         bnames = list(b.columns)
-        n = a.nrows + b.nrows
-        cap = bucket_cap(max(n, 1))
+        cap = bucket_cap(max(a.cap + b.cap, 1))
+        pad_n = cap - a.cap - b.cap
+        live = jnp.pad(
+            jnp.concatenate([a.row_mask(), b.row_mask()]), (0, pad_n)
+        )
+        n_lazy = (
+            a.nrows_lazy + b.nrows_lazy
+        )  # int + int stays host; device scalars stay lazy
         cols = {}
         for an, bn in zip(names, bnames):
             ca, cb = a.columns[an], b.columns[bn]
-            da, db = ca, cb
             # unify dtypes
             if ca.dtype.is_string or cb.dtype.is_string:
                 from .expr import _share_dictionary
@@ -1732,16 +1756,15 @@ class Executor:
                 da = _cast_column(ca, dtype, ca.data.shape[0])
                 db = _cast_column(cb, dtype, cb.data.shape[0])
                 dictionary = None
-            data = jnp.concatenate([da.data[: a.nrows], db.data[: b.nrows]])
-            data = jnp.pad(data, (0, cap - n))
-            va = da.valid[: a.nrows] if da.valid is not None else jnp.ones(a.nrows, bool)
-            vb = db.valid[: b.nrows] if db.valid is not None else jnp.ones(b.nrows, bool)
+            data = jnp.pad(jnp.concatenate([da.data, db.data]), (0, pad_n))
             if da.valid is None and db.valid is None:
                 valid = None
             else:
-                valid = jnp.pad(jnp.concatenate([va, vb]), (0, cap - n))
+                va = da.valid if da.valid is not None else jnp.ones(a.cap, bool)
+                vb = db.valid if db.valid is not None else jnp.ones(b.cap, bool)
+                valid = jnp.pad(jnp.concatenate([va, vb]), (0, pad_n))
             cols[an] = Column(data, dtype, valid, dictionary)
-        return Table(cols, n)
+        return Table(cols, n_lazy, live=live)
 
 
 def _segment_cumsum(x, gid):
