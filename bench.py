@@ -168,13 +168,30 @@ def bench_geomean(sess):
         box = {}
 
         def work():
-            try:
-                r = sess.run_script(q)
-                if r is not None:
-                    r.collect()
+            def attempt():
+                # error as TEXT, never a live exception: a held traceback
+                # would pin the failed attempt's device intermediates
+                # through the recovery
+                try:
+                    r = sess.run_script(q)
+                    if r is not None:
+                        r.collect()
+                    return None
+                except Exception as exc:
+                    return str(exc) or type(exc).__name__
+
+            err = attempt()
+            if err is not None and "RESOURCE_EXHAUSTED" in err:
+                # mid-execution device OOM: drop caches, retry once on a
+                # clean device (one OOM must not poison the stream)
+                sess.recover_memory("device memory exhausted")
+                err = attempt()
+                if err is not None and "RESOURCE_EXHAUSTED" in err:
+                    sess.recover_memory("device memory exhausted")
+            if err is None:
                 box["ok"] = True
-            except Exception as exc:  # surfaced to the caller
-                box["exc"] = exc
+            else:
+                box["exc"] = RuntimeError(err)
 
         th = threading.Thread(target=work, daemon=True)
         th.start()

@@ -120,9 +120,47 @@ class _Entry:
 
 
 class Catalog:
+    # device-column cache budget: stay well under the 16 GB v5e HBM so
+    # query intermediates (which can transiently need several GB) never
+    # collide with table residency; least-recently-used tables evict first
+    DEVICE_BUDGET_BYTES = int(
+        os.environ.get("NDS_CATALOG_BUDGET_BYTES", 6 << 30)
+    )
+
     def __init__(self, session):
         self.session = session
         self.entries = {}  # name -> _Entry
+        self._use_tick = 0
+
+    def _cached_bytes(self, e) -> int:
+        total = 0
+        for c in e.device_cols.values():
+            total += int(c.data.nbytes)
+            if c.valid is not None:
+                total += int(c.valid.nbytes)
+        return total
+
+    def _evict_to_budget(self, keep_name):
+        total = sum(self._cached_bytes(e) for e in self.entries.values())
+        if total <= self.DEVICE_BUDGET_BYTES:
+            return
+        victims = sorted(
+            (
+                (name, e)
+                for name, e in self.entries.items()
+                if name != keep_name and e.device_cols
+            ),
+            key=lambda kv: getattr(kv[1], "last_use", 0),
+        )
+        for name, e in victims:
+            total -= self._cached_bytes(e)
+            e.device_cols = {}
+            # routine budget management, NOT a task failure: reporting it
+            # through the listener channel would flip successful queries
+            # to CompletedWithTaskFailures
+            print(f"catalog: evicted device columns of {name!r} (budget)")
+            if total <= self.DEVICE_BUDGET_BYTES:
+                return
 
     def schema(self, name):
         e = self.entries.get(name)
@@ -194,6 +232,8 @@ class Catalog:
         e = self.entries.get(name)
         if e is None:
             raise KeyError(f"unknown table {name}")
+        self._use_tick += 1
+        e.last_use = self._use_tick
         if columns is None:
             sch = self.schema(name)
             columns = sch.names
@@ -213,11 +253,10 @@ class Catalog:
             except Exception as exc:  # recoverable device OOM: drop + retry
                 if "RESOURCE_EXHAUSTED" not in str(exc):
                     raise
-                for other in self.entries.values():
-                    other.device_cols = {}
-                import gc
-
-                gc.collect()
+                # full recovery (plan cache included) — a retained result
+                # cache could otherwise keep the reload OOMing
+                self.session.recover_memory("device memory exhausted "
+                                            f"loading {name!r}")
                 # the wipe dropped this entry's cache too — reload the full
                 # requested column set, not just the previously-missing ones
                 t = _load(columns)
@@ -227,6 +266,7 @@ class Catalog:
                 )
             e.nrows = t.nrows
             e.device_cols.update(t.columns)
+            self._evict_to_budget(keep_name=name)
         if e.nrows is None:
             # all requested columns cached but nrows unset (can't happen in
             # practice; guard for empty column list)
@@ -371,7 +411,7 @@ class Session:
         self.catalog = Catalog(self)
         self._listeners = []  # task-failure observers (harness parity)
         self.plan_cache = _PlanResultCache(
-            int(self.conf.get("engine.plan_cache_bytes", 2 << 30))
+            int(self.conf.get("engine.plan_cache_bytes", 1 << 30))
         )
 
     def _catalog_changed(self):
@@ -436,6 +476,22 @@ class Session:
     def drop(self, name):
         self._catalog_changed()
         self.catalog.entries.pop(name.lower(), None)
+
+    # ---- memory recovery -------------------------------------------------
+    def recover_memory(self, reason: str = "device OOM"):
+        """Drop every recoverable device allocation: the plan-result cache
+        and all cached catalog columns. Called by the harness loops when a
+        query dies with RESOURCE_EXHAUSTED mid-execution (the catalog's
+        own load-time retry cannot see those), after which the query is
+        retried once against a clean device (reference analogue: Spark
+        executor loss -> task retry on a fresh executor)."""
+        import gc
+
+        self.plan_cache.clear()
+        for e in self.catalog.entries.values():
+            e.device_cols = {}
+        gc.collect()
+        self.notify_failure(f"task retry: {reason}; dropped device caches")
 
     # ---- listeners (reference: python_listener/PythonListener.py) --------
     def register_listener(self, cb):

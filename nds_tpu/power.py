@@ -173,7 +173,8 @@ def run_query_stream(
         print(f"====== Run {query_name} ======")
         q_report = BenchReport(session)
         summary = q_report.report_on(
-            run_one_query, session, q_content, query_name, output_path, output_format
+            run_one_query, session, q_content, query_name, output_path,
+            output_format, retry_oom=True,  # read-only: idempotent
         )
         print(f"Time taken: {summary['queryTimes']} millis for {query_name}")
         execution_time_list.append((app_id, query_name, summary["queryTimes"][0]))

@@ -58,8 +58,11 @@ class BenchReport:
             "queryTimes": [],
         }
 
-    def report_on(self, fn: Callable, *args):
-        """Run fn(*args), recording env (secrets redacted), status and time."""
+    def report_on(self, fn: Callable, *args, retry_oom: bool = False):
+        """Run fn(*args), recording env (secrets redacted), status and time.
+
+        retry_oom: retry ONCE after device-memory exhaustion (caller must
+        guarantee fn is idempotent — read-only queries yes, DML no)."""
         env_vars = {
             k: v
             for k, v in os.environ.items()
@@ -76,21 +79,47 @@ class BenchReport:
         except AttributeError:
             pass
         start_time = int(time.time() * 1000)
+
+        def _attempt():
+            # returns the error text, WITHOUT holding the exception (a live
+            # traceback would pin the failed attempt's multi-GB device
+            # intermediates through any recovery/retry)
+            try:
+                fn(*args)
+                return None
+            except Exception as e:
+                return str(e) or type(e).__name__
+
         try:
-            fn(*args)
-            end_time = int(time.time() * 1000)
+            err = _attempt()
+            if (
+                err is not None
+                and "RESOURCE_EXHAUSTED" in err
+                and hasattr(self.session, "recover_memory")
+            ):
+                # device memory exhaustion mid-execution: drop every
+                # recoverable allocation; retry once on the clean device
+                # when fn is idempotent — without the recovery, one OOM
+                # poisons the whole remaining stream (reference analogue:
+                # executor loss -> task retry on a fresh executor)
+                self.session.recover_memory("device memory exhausted")
+                if retry_oom:
+                    err = _attempt()
+                    if err is not None and "RESOURCE_EXHAUSTED" in err:
+                        self.session.recover_memory("device memory exhausted")
+        finally:
+            if registered:
+                self.session.unregister_listener(failures.append)
+        end_time = int(time.time() * 1000)
+        if err is None:
             if failures:
                 self.summary["queryStatus"].append("CompletedWithTaskFailures")
             else:
                 self.summary["queryStatus"].append("Completed")
-        except Exception as e:  # a failed query must not abort the stream
-            print(e)
-            end_time = int(time.time() * 1000)
+        else:  # a failed query must not abort the stream
+            print(err)
             self.summary["queryStatus"].append("Failed")
-            self.summary["exceptions"].append(str(e))
-        finally:
-            if registered:
-                self.session.unregister_listener(failures.append)
+            self.summary["exceptions"].append(err)
         self.summary["startTime"] = start_time
         self.summary["queryTimes"].append(end_time - start_time)
         if failures:
