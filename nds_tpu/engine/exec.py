@@ -980,16 +980,19 @@ class Executor:
             return self._aggregate_once(
                 node.keys, node.aggs, None, child, live, nlive
             )
-        parts = []
+        # ROLLUP: concat incrementally and never retain the per-set parts
+        # (q67's nine sets at fact-scale group caps held several GB), then
+        # pack the masked concat chain before downstream windows/sorts —
+        # a hard device OOM is UNRECOVERABLE on this backend (the axon
+        # terminal stays poisoned even after every buffer is freed and the
+        # client is re-created), so peak memory is a correctness concern.
+        out = None
         for s in node.grouping_sets:
-            parts.append(
-                self._aggregate_once(node.keys, node.aggs, s, child, live,
-                                     nlive)
+            part = self._aggregate_once(
+                node.keys, node.aggs, s, child, live, nlive
             )
-        out = parts[0]
-        for p in parts[1:]:
-            out = self._concat(out, p)
-        return out
+            out = part if out is None else self._concat(out, part)
+        return out.compacted()
 
     def _agg_input(self, node: P.Aggregate):
         """Aggregation input as (table, live mask, known row count|None).
@@ -1377,7 +1380,9 @@ class Executor:
 
     # ------------------------------------------------------------------
     def _exec_window(self, node: P.Window) -> Table:
-        child = self.execute(node.child)
+        # windows sort and scan several word/rank arrays at the input cap:
+        # always pack masked inputs first (memory AND time win)
+        child = self.execute(node.child).compacted()
         out_cols = dict(child.columns)
         for wf, name in node.fns:
             out_cols[name] = self._eval_window(child, wf)
