@@ -22,8 +22,14 @@ parse always sees the most complete results even if the process is killed
 mid-run (the round-3 rc=124 timeout recorded nothing because the single
 print sat at the very end).
 
+The OUT object is self-describing (per-run evidence, not just the headline):
+`per_query` {name: {cold, steady}}, `slowest5` [[name, steady]...], and
+`failed` {name: error text} ride along with the geomean so a killed or
+failed run still leaves per-query times and failure reasons in the artifact.
+
 Env knobs: NDS_BENCH_SCALE (default 1), NDS_BENCH_DATA,
-NDS_BENCH_SKIP_GEOMEAN, NDS_BENCH_SKIP_TRANSCODE, NDS_BENCH_QUERY_TIMEOUT.
+NDS_BENCH_SKIP_GEOMEAN, NDS_BENCH_SKIP_TRANSCODE, NDS_BENCH_QUERY_TIMEOUT,
+NDS_BENCH_QUERY_SUBSET (comma-separated query names, debug aid).
 """
 
 import json
@@ -154,8 +160,15 @@ def bench_geomean(sess):
     with tempfile.TemporaryDirectory() as d:
         generate_streams(d, 1, SCALE, rngseed=19620718)
         queries = gen_sql_from_stream(os.path.join(d, "query_0.sql"))
-    per_query = {}
-    failed = []
+    subset = os.environ.get("NDS_BENCH_QUERY_SUBSET")
+    if subset:
+        keep = {s.strip() for s in subset.split(",") if s.strip()}
+        queries = {n: q for n, q in queries.items() if n in keep}
+        if not queries:
+            print(f"NDS_BENCH_QUERY_SUBSET={subset!r} matched no queries "
+                  f"(names look like 'query3')", file=sys.stderr)
+    detail = {}      # name -> {"cold": s, "steady": s}; steady feeds geomean
+    failed = {}      # name -> error text (artifact evidence)
 
     # daemon-thread timeout: a wedged device runtime blocks inside native
     # code where signals never fire; joining a daemon thread with a timeout
@@ -214,15 +227,26 @@ def bench_geomean(sess):
         return "timeout"
 
     def update_out():
-        if per_query:
+        if detail:
             geo = math.exp(
-                sum(math.log(max(t, 1e-4)) for t in per_query.values())
-                / len(per_query)
+                sum(math.log(max(v["steady"], 1e-4)) for v in detail.values())
+                / len(detail)
             )
             OUT["geomean_query_sec"] = round(geo, 4)
-        OUT["geomean_queries"] = len(per_query)
+        OUT["geomean_queries"] = len(detail)
+        OUT["per_query"] = {
+            n: {"cold": round(v["cold"], 2), "steady": round(v["steady"], 3)}
+            for n, v in detail.items()
+        }
+        OUT["slowest5"] = [
+            [n, round(v["steady"], 2)]
+            for n, v in sorted(
+                detail.items(), key=lambda kv: -kv[1]["steady"]
+            )[:5]
+        ]
         if failed:
-            OUT["failed_queries"] = list(failed)
+            OUT["failed_queries"] = sorted(failed)
+            OUT["failed"] = {n: e[:500] for n, e in failed.items()}
         emit()
 
     for i, (name, q) in enumerate(queries.items()):
@@ -239,19 +263,21 @@ def bench_geomean(sess):
                 try:
                     t0 = time.perf_counter()
                     status = run_with_timeout(q, per_query_budget)
-                    per_query[name] = time.perf_counter() - t0
+                    detail[name] = {
+                        "cold": cold, "steady": time.perf_counter() - t0,
+                    }
                 finally:
                     sess.conf["engine.plan_cache"] = "on"
             if status == "ok":
                 print(
                     f"[{i + 1}/{len(queries)}] {name}: cold={cold:.1f}s "
-                    f"steady={per_query[name]:.2f}s",
+                    f"steady={detail[name]['steady']:.2f}s",
                     file=sys.stderr,
                 )
                 update_out()
                 continue
-            failed.append(name)
-            per_query.pop(name, None)
+            failed[name] = f"timeout (> {per_query_budget}s, {status})"
+            detail.pop(name, None)
             print(f"[{i + 1}/{len(queries)}] {name}: TIMEOUT "
                   f"(> {per_query_budget}s)", file=sys.stderr)
             update_out()
@@ -260,7 +286,7 @@ def bench_geomean(sess):
                       "wedged; aborting geomean", file=sys.stderr)
                 break
         except Exception as exc:
-            failed.append(name)
+            failed[name] = str(exc) or type(exc).__name__
             print(f"[{i + 1}/{len(queries)}] {name}: FAILED {exc}",
                   file=sys.stderr)
             update_out()
