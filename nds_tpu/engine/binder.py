@@ -131,36 +131,127 @@ class Binder:
 
     # ------------------------------------------------------------------
     def _bind_core(self, stmt: A.SelectStmt, outer, views):
-        # 1. FROM
+        # 1. FROM — flatten explicit INNER JOIN ... ON chains into the same
+        # relation list + conjunct pool as comma-FROM/WHERE queries, so ON
+        # equalities become MultiJoin edges the greedy order optimizer can
+        # reorder and merge into multi-key joins. Left-deep binary execution
+        # of the q72 shape (catalog_sales JOIN inventory ON item_sk alone,
+        # week/date constraints arriving only via later date_dim joins)
+        # otherwise materializes a ~1e9-pair candidate table. LEFT JOINs are
+        # held back as pending joins applied after the inner core (they
+        # commute with inner joins on preserved-side columns); other kinds
+        # (right/full/semi/anti) stay opaque binary trees.
         relations = []
+        # (conjunct AST, visible relation range [lo, hi)): an ON clause
+        # sees exactly its join's operands — the relations flattened under
+        # that JoinClause — not sibling FROM items, so each conjunct
+        # remembers its operand range and is later bound in that narrowed
+        # scope (a bare column ambiguous against a sibling's column, or a
+        # forward reference, must behave as it did under binary binding)
+        on_conjuncts = []
+        pending_left = []  # (relation index, raw ON AST, visible-from idx)
+        outer_idx = set()  # relation indices held out of the MultiJoin
+
+        def flatten(item):
+            if isinstance(item, A.JoinClause) and item.kind in (
+                "inner", "cross",
+            ):
+                lo = len(relations)
+                flatten(item.left)
+                flatten(item.right)
+                if item.on is not None:
+                    hi = len(relations)
+                    on_conjuncts.extend(
+                        (c, lo, hi) for c in _conjuncts(item.on)
+                    )
+                return
+            if isinstance(item, A.JoinClause) and item.kind == "left":
+                lo = len(relations)
+                flatten(item.left)
+                r = self._bind_from_item(item.right, outer, views)
+                relations.append(r)
+                outer_idx.add(len(relations) - 1)
+                pending_left.append((len(relations) - 1, item.on, lo))
+                return
+            relations.append(self._bind_from_item(item, outer, views))
+
         if stmt.from_items:
             for item in stmt.from_items:
-                relations.append(self._bind_from_item(item, outer, views))
+                flatten(item)
         else:
             # FROM-less SELECT: single-row dummy relation
             relations.append(Relation(P.MaterializedScan("__dual__"), "__dual__", []))
         scope = Scope(relations, outer)
 
-        # 2. WHERE classification
+        # 2. WHERE + flattened-ON classification
         filters_per_rel = {i: [] for i in range(len(relations))}
         edges = []
         residual = []
         post_join_subqueries = []  # (kind, ...) applied after MultiJoin
+        # pool entries are (conjunct, binding scope): ON conjuncts bind in
+        # their join's operand range, WHERE conjuncts in the full scope
+        raw_conjuncts = []
+        for c, lo, hi in on_conjuncts:
+            cscope = Scope(relations[lo:hi], outer)
+            # factor conjuncts common to every OR branch so join keys
+            # buried in disjunctions (TPC-DS q13/q48 shape) become edges
+            # instead of forcing a cross join
+            raw_conjuncts.extend((f, cscope) for f in _factor_or(c))
         if stmt.where is not None:
             for raw in _conjuncts(stmt.where):
-                # factor conjuncts common to every OR branch so join keys
-                # buried in disjunctions (TPC-DS q13/q48 shape) become edges
-                # instead of forcing a cross join
-                for conj in _factor_or(raw):
-                    self._classify_conjunct(
-                        conj, scope, relations, views,
-                        filters_per_rel, edges, residual, post_join_subqueries,
-                    )
+                raw_conjuncts.extend((f, scope) for f in _factor_or(raw))
 
-        # 3. assemble join tree
+        # Null-rejection promotion: a strict comparison in the conjunct pool
+        # that references a pending LEFT JOIN's right side filters out that
+        # join's null-extended rows, so the join is semantically INNER.
+        # Promote it into the MultiJoin core — otherwise its equalities are
+        # unusable as edges and the core disconnects into a cross join
+        # (TPC-DS q93: `ss LEFT JOIN sr ON ..., reason WHERE
+        # sr_reason_sk = r_reason_sk` would execute store_sales x reason).
+        rel_cols = [{qn for qn, _, _ in r.columns} for r in relations]
+        work = list(raw_conjuncts)
+        while work and outer_idx:
+            conj, cscope = work.pop()
+            if not _null_rejecting_shape(conj):
+                continue
+            try:
+                refs = _refs(self._bind_expr(conj, cscope, views))
+            except BindError:
+                continue
+            for idx in sorted(outer_idx):
+                if refs & rel_cols[idx]:
+                    outer_idx.discard(idx)
+                    for pi, (pidx, on_ast, plo) in enumerate(pending_left):
+                        if pidx == idx:
+                            pending_left.pop(pi)
+                            if on_ast is not None:
+                                pscope = Scope(
+                                    relations[plo:pidx + 1], outer
+                                )
+                                newc = [
+                                    (c, pscope)
+                                    for r_ in _conjuncts(on_ast)
+                                    for c in _factor_or(r_)
+                                ]
+                                raw_conjuncts.extend(newc)
+                                work.extend(newc)
+                            break
+
+        for conj, cscope in raw_conjuncts:
+            self._classify_conjunct(
+                conj, cscope, relations, views,
+                filters_per_rel, edges, residual, post_join_subqueries,
+                joinable=set(range(len(relations))) - outer_idx,
+            )
+
+        # 3. assemble join tree: inner MultiJoin core, then pending LEFT
+        # joins in FROM order, then the residual filter (WHERE applies
+        # after all FROM joins)
+        inner_order = [i for i in range(len(relations)) if i not in outer_idx]
+        remap = {i: pos for pos, i in enumerate(inner_order)}
         rel_plans = []
-        for i, r in enumerate(relations):
-            p = r.plan
+        for i in inner_order:
+            p = relations[i].plan
             preds = filters_per_rel[i]
             if preds:
                 p = P.Filter(_conjoin(preds), p)
@@ -168,7 +259,29 @@ class Binder:
         if len(rel_plans) == 1 and not edges:
             base = rel_plans[0]
         else:
-            base = P.MultiJoin(rel_plans, edges, None)
+            base = P.MultiJoin(
+                rel_plans,
+                [(remap[i], remap[j], le, re_) for (i, j, le, re_) in edges],
+                None,
+            )
+        applied_cols = set()
+        for i in inner_order:
+            applied_cols |= {qn for qn, _, _ in relations[i].columns}
+        for idx, on_ast, plo in pending_left:
+            r = relations[idx]
+            rcols = {qn for qn, _, _ in r.columns}
+            lkeys, rkeys, jres = [], [], []
+            if on_ast is not None:
+                cond = self._bind_expr(
+                    on_ast, Scope(relations[plo:idx + 1], outer), views
+                )
+                lkeys, rkeys, jres = _split_equi_conjuncts(
+                    _conjuncts(cond), applied_cols, rcols
+                )
+            base = P.Join(
+                "left", base, r.plan, lkeys, rkeys, _conjoin_ast(jres)
+            )
+            applied_cols |= rcols
         if residual:
             base = P.Filter(_conjoin(residual), base)
         # semi/anti/scalar-correlated joins after the main join
@@ -448,30 +561,14 @@ class Binder:
         scope = Scope([left, right], outer)
         lcols = {qn for qn, _, _ in left.columns}
         rcols = {qn for qn, _, _ in right.columns}
-        lkeys, rkeys, residual = [], [], []
         if jc.on is not None:
             cond = self._bind_expr(jc.on, scope, views)
-            for conj in _conjuncts(cond):
-                side_l = _refs(conj) & lcols
-                side_r = _refs(conj) & rcols
-                if (
-                    isinstance(conj, E.BinOp)
-                    and conj.op == "="
-                    and side_l
-                    and side_r
-                ):
-                    le, re_ = conj.left, conj.right
-                    if _refs(le) <= lcols and _refs(re_) <= rcols:
-                        lkeys.append(le)
-                        rkeys.append(re_)
-                        continue
-                    if _refs(le) <= rcols and _refs(re_) <= lcols:
-                        lkeys.append(re_)
-                        rkeys.append(le)
-                        continue
-                residual.append(conj)
-            res = _conjoin(residual) if residual else None
+            lkeys, rkeys, residual = _split_equi_conjuncts(
+                _conjuncts(cond), lcols, rcols
+            )
+            res = _conjoin_ast(residual)
         else:
+            lkeys, rkeys = [], []
             res = None
         kind = jc.kind
         node = P.Join(kind, left.plan, right.plan, lkeys, rkeys, res)
@@ -483,8 +580,10 @@ class Binder:
     # ------------------------------------------------------------------
     def _classify_conjunct(
         self, conj, scope, relations, views,
-        filters_per_rel, edges, residual, post_join,
+        filters_per_rel, edges, residual, post_join, joinable=None,
     ):
+        if joinable is None:
+            joinable = set(range(len(relations)))
         # subquery predicates
         subs = [x for x in E.walk(conj) if isinstance(x, E.SubqueryExpr)]
         if subs:
@@ -509,12 +608,19 @@ class Binder:
         touching = [i for i, s in enumerate(rel_sets) if refs & s]
         if len(touching) <= 1:
             i = touching[0] if touching else 0
+            if touching and i not in joinable:
+                # references a pending LEFT JOIN's right side: must apply
+                # after that join (a WHERE filter on null-extended columns
+                # does not commute with the outer join)
+                residual.append(bound)
+                return
             filters_per_rel[i].append(bound)
             return
         if (
             isinstance(bound, E.BinOp)
             and bound.op == "="
             and len(touching) == 2
+            and all(i in joinable for i in touching)
         ):
             i, j = touching
             le, re_ = bound.left, bound.right
@@ -1039,6 +1145,52 @@ def _conjoin_ast(preds):
 
 def _refs(e):
     return {x.name for x in E.walk(e) if isinstance(x, E.Col)}
+
+
+def _null_rejecting_shape(conj):
+    """True if the (unbound) conjunct is a comparison that is strict in its
+    column references: any NULL operand makes it NULL, i.e. it filters out
+    null-extended rows of every relation it touches. Conservative — any
+    null-tolerant wrapper (IS NULL, CASE, coalesce) or subquery disqualifies.
+    Drives LEFT-JOIN -> INNER promotion in _bind_core."""
+    if not (
+        isinstance(conj, E.BinOp)
+        and conj.op in ("=", "<", ">", "<=", ">=", "<>", "!=")
+    ):
+        return False
+    for x in E.walk(conj):
+        if isinstance(x, E.UnaryOp) and x.op in ("isnull", "isnotnull"):
+            return False
+        if isinstance(x, (E.Case, E.SubqueryExpr, E.ScalarSubquery)):
+            return False
+        if isinstance(x, E.Func) and x.name.lower() in (
+            "coalesce", "ifnull", "nvl",
+        ):
+            return False
+    return True
+
+
+def _split_equi_conjuncts(conjuncts, lcols, rcols):
+    """Partition bound ON conjuncts into equi-key pairs (left expr over
+    lcols, right expr over rcols) and a residual list. Shared by the binary
+    join path and the pending-LEFT-JOIN assembly so the two stay in
+    lockstep."""
+    lkeys, rkeys, residual = [], [], []
+    for conj in conjuncts:
+        if isinstance(conj, E.BinOp) and conj.op == "=":
+            le, re_ = conj.left, conj.right
+            refs_l, refs_r = _refs(le), _refs(re_)
+            if refs_l and refs_r:
+                if refs_l <= lcols and refs_r <= rcols:
+                    lkeys.append(le)
+                    rkeys.append(re_)
+                    continue
+                if refs_l <= rcols and refs_r <= lcols:
+                    lkeys.append(re_)
+                    rkeys.append(le)
+                    continue
+        residual.append(conj)
+    return lkeys, rkeys, residual
 
 
 def _rewrite_children(e, fn):

@@ -85,14 +85,10 @@ def get_refresh_time(maintenance_report_file):
 
 
 def get_throughput_time(time_log_base, num_streams, first_or_second):
-    from .throughput import _read_start_end
+    from .throughput import _ttt_from_logs
 
-    starts, ends = [], []
-    for n in get_stream_range(num_streams, first_or_second):
-        s, e = _read_start_end(f"{time_log_base}_{n}.csv")
-        starts.append(s)
-        ends.append(e)
-    return round_up_to_nearest_10_percent(max(ends) - min(starts))
+    streams = {n: None for n in get_stream_range(num_streams, first_or_second)}
+    return _ttt_from_logs(streams, time_log_base)
 
 
 def get_maintenance_time(report_base, num_streams, first_or_second):

@@ -137,6 +137,7 @@ def run_query_stream(
     json_summary_folder=None,
     keep_session=False,
     mesh_devices=None,
+    start_gate=None,
 ):
     """Run the stream sequentially with per-query timing and reports.
 
@@ -168,7 +169,16 @@ def run_query_stream(
     )
     if sub_queries:
         query_dict = get_query_subset(query_dict, sub_queries)
-    power_start = int(time.time())
+    if start_gate is not None:
+        # concurrent-stream rendezvous (throughput driver): every stream
+        # finishes setup before any stream's Power clock starts, and the
+        # gate's shared release timestamp becomes the stream's start, so
+        # the [start, end] windows overlap by construction rather than by
+        # scheduling luck on a loaded host
+        gate_t = start_gate()
+        power_start = int(gate_t) if gate_t is not None else int(time.time())
+    else:
+        power_start = int(time.time())
     for query_name, q_content in query_dict.items():
         print(f"====== Run {query_name} ======")
         q_report = BenchReport(session)

@@ -18,6 +18,7 @@ import csv
 import math
 import os
 import threading
+import time
 
 from .power import gen_sql_from_stream, run_query_stream
 
@@ -69,6 +70,21 @@ def run_throughput(
             output_format, sub_queries,
         )
     errors = {}
+    # All streams rendezvous after table setup, before their Power clocks
+    # start, and share ONE release timestamp (the barrier action runs in
+    # exactly one thread at trip time): overlap of the [start, end] windows
+    # is then structural, immune to the 1-core host scheduling one thread's
+    # first query before another thread gets to read its own clock. A
+    # stream that errors before reaching the gate aborts it for everyone
+    # rather than deadlocking the rest.
+    epoch = {}
+    gate = threading.Barrier(
+        len(stream_paths), action=lambda: epoch.__setitem__("t", time.time())
+    )
+
+    def start_gate():
+        gate.wait(timeout=600)
+        return epoch["t"]
 
     def one_stream(n, path):
         try:
@@ -96,9 +112,11 @@ def run_throughput(
                     f"{output_path}_{n}" if output_path else None
                 ),
                 output_format=output_format,
+                start_gate=start_gate,
             )
-        except Exception as exc:  # surface after join; don't kill siblings
+        except Exception as exc:
             errors[n] = exc
+            gate.abort()  # release siblings still parked at the gate
 
     threads = [
         threading.Thread(target=one_stream, args=(n, p), name=f"stream-{n}")
@@ -109,18 +127,28 @@ def run_throughput(
     for t in threads:
         t.join()
     if errors:
-        raise RuntimeError(f"throughput streams failed: {errors}")
+        # a pre-gate failure aborts the barrier, flooding every sibling
+        # with BrokenBarrierError; report only the root cause(s)
+        real = {
+            n: e for n, e in errors.items()
+            if not isinstance(e, threading.BrokenBarrierError)
+        }
+        raise RuntimeError(f"throughput streams failed: {real or errors}")
     return _ttt_from_logs(stream_paths, time_log_base)
 
 
 def _ttt_from_logs(stream_paths, time_log_base) -> float:
-    """Ttt = max(stream end) - min(stream start), rounded up to 0.1 s."""
+    """Ttt = max(stream end) - min(stream start), rounded up to 0.1 s.
+
+    Floored at 0.1 s: the time log's int-second timestamps truncate a
+    sub-second run to 0, and Ttt feeds the composite metric's denominator
+    (nds/nds_bench.py:334-357) where 0 would poison the whole score."""
     starts, ends = [], []
     for n in stream_paths:
         s, e = _read_start_end(f"{time_log_base}_{n}.csv")
         starts.append(s)
         ends.append(e)
-    return round_up_to_nearest_10_percent(max(ends) - min(starts))
+    return max(round_up_to_nearest_10_percent(max(ends) - min(starts)), 0.1)
 
 
 def _run_throughput_processes(

@@ -177,3 +177,72 @@ def test_empty_rows_frame(sess):
         "rows between 2 preceding and 1 preceding) s from w order by o"
     ).collect()
     assert out.column("s").to_pylist() == [None, 1, 11]
+
+
+def test_flattened_on_scope():
+    """Inner-JOIN flattening must bind ON conjuncts in the join's own
+    operand scope: a bare column that collides with a sibling FROM item
+    stays unambiguous, and forward references stay rejected."""
+    import pyarrow as pa
+    import pytest as _pt
+
+    from nds_tpu.engine.binder import BindError
+    from nds_tpu.engine.session import Session
+
+    s = Session()
+    s.register_arrow("sa", pa.table({"x": pa.array([1, 2], pa.int32())}))
+    s.register_arrow("sb", pa.table({"bx": pa.array([1, 2], pa.int32())}))
+    s.register_arrow("sc", pa.table(
+        {"x": pa.array([9], pa.int32()), "cy": pa.array([7], pa.int32())}
+    ))
+    out = s.sql("select count(*) c from sa join sb on x = bx, sc").collect()
+    assert out.column("c").to_pylist() == [2]
+    with _pt.raises(BindError):
+        s.sql("select * from sa join sb on sa.x = sc.cy, sc").collect()
+
+
+def test_left_join_null_rejection_promotion():
+    """TPC-DS q93 shape: a WHERE equality against a LEFT JOIN's right side
+    null-rejects it, so the planner may treat the join as inner — the
+    MultiJoin core must not disconnect into a cross join and results must
+    match the filtered-inner semantics."""
+    import pyarrow as pa
+
+    from nds_tpu.engine.session import Session
+
+    s = Session()
+    s.register_arrow("f", pa.table({
+        "k": pa.array([1, 2, 3], pa.int32()),
+        "t": pa.array([10, 20, 30], pa.int32()),
+    }))
+    s.register_arrow("r", pa.table({
+        "k2": pa.array([1, 3], pa.int32()),
+        "rs": pa.array([5, 6], pa.int32()),
+    }))
+    s.register_arrow("d", pa.table({"rid": pa.array([5], pa.int32())}))
+    out = s.sql(
+        "select count(*) c, sum(t) st from f "
+        "left outer join r on (k2 = k), d where rs = rid"
+    ).collect()
+    # only k=1 survives (rs=5 matches rid=5); k=2's null rs is rejected
+    assert out.column("c").to_pylist() == [1]
+    assert out.column("st").to_pylist() == [10]
+
+
+def test_left_join_stays_outer_without_rejection():
+    """Without a null-rejecting WHERE reference the LEFT JOIN must keep
+    its null-extended rows (q72 shape: right side only read via IS NULL
+    in the SELECT list)."""
+    import pyarrow as pa
+
+    from nds_tpu.engine.session import Session
+
+    s = Session()
+    s.register_arrow("f2", pa.table({"k": pa.array([1, 2], pa.int32())}))
+    s.register_arrow("p2", pa.table({"pk": pa.array([1], pa.int32())}))
+    out = s.sql(
+        "select sum(case when pk is null then 1 else 0 end) nn, count(*) c "
+        "from f2 left outer join p2 on (pk = k)"
+    ).collect()
+    assert out.column("c").to_pylist() == [2]
+    assert out.column("nn").to_pylist() == [1]

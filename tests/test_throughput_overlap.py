@@ -49,9 +49,14 @@ def warehouse(tmp_path_factory):
 def _write_stream(path, n_queries):
     parts = []
     for i in range(n_queries):
+        # vary a constant per query so the session plan-result cache can't
+        # collapse the stream into one execution + 7 dict hits
+        q = SMOKE_QUERY.replace(
+            "group by", f"and d_moy <= {12 - (i % 12)} group by"
+        )
         parts.append(
             f"-- start query {i + 1} in stream 0 using template query3.tpl\n"
-            f"{SMOKE_QUERY}\n;\n"
+            f"{q}\n;\n"
             f"-- end query {i + 1} in stream 0 using template query3.tpl\n"
         )
     with open(path, "w") as f:
@@ -69,9 +74,32 @@ def _window(log):
     return start, end
 
 
+def _summary_window_ms(folder):
+    """[first query start, last query end] in ms from a stream's per-query
+    JSON summaries — fractional evidence of when the stream actually ran,
+    independent of the int-second time log."""
+    import glob
+    import json
+
+    lo = hi = None
+    for p in glob.glob(os.path.join(folder, "*.json")):
+        with open(p) as f:
+            s = json.load(f)
+        start = s["startTime"]
+        end = start + sum(s["queryTimes"])
+        lo = start if lo is None else min(lo, start)
+        hi = end if hi is None else max(hi, end)
+    assert lo is not None, f"no summaries in {folder}"
+    return lo, hi
+
+
 def test_thread_streams_overlap(warehouse, tmp_path):
-    # enough queries that each stream runs several seconds: the time log's
-    # 1-second resolution must not fake an overlap between serial streams
+    # The streams rendezvous on run_throughput's start gate after setup, so
+    # the int-second time-log windows share one start by construction. The
+    # genuine-concurrency proof uses the per-query JSON summaries' ms
+    # timestamps: if a regression serialized the streams (whole-stream GIL
+    # hold), stream A's last query would end before stream B's first began
+    # and the strict window intersection below would fail.
     for n in (1, 2):
         _write_stream(tmp_path / f"query_{n}.sql", 8)
     base = str(tmp_path / "tt")
@@ -80,17 +108,20 @@ def test_thread_streams_overlap(warehouse, tmp_path):
         {1: str(tmp_path / "query_1.sql"), 2: str(tmp_path / "query_2.sql")},
         base,
         input_format="parquet",
+        json_summary_folder=str(tmp_path / "summaries"),
     )
     assert ttt > 0
     s1, e1 = _window(f"{base}_1.csv")
     s2, e2 = _window(f"{base}_2.csv")
-    assert e1 - s1 >= 2 and e2 - s2 >= 2, (
-        "streams too fast to prove overlap", s1, e1, s2, e2)
-    # strict interval intersection: each stream started before the other
-    # finished
-    assert s1 < e2 and s2 < e1, (s1, e1, s2, e2)
+    # gate-aligned starts: both streams record the shared release timestamp
+    assert s1 == s2, (s1, e1, s2, e2)
     # Ttt spans the union of the windows (reference Ttt semantics)
     assert ttt >= max(e1, e2) - min(s1, s2)
+    # strict fractional-window intersection: each stream ran a query while
+    # the other was still mid-stream
+    f1 = _summary_window_ms(str(tmp_path / "summaries" / "stream_1"))
+    f2 = _summary_window_ms(str(tmp_path / "summaries" / "stream_2"))
+    assert f1[0] < f2[1] and f2[0] < f1[1], (f1, f2)
 
 
 def test_process_mode_streams(warehouse, tmp_path):
