@@ -266,10 +266,10 @@ class Binder:
             )
         applied_cols = set()
         for i in inner_order:
-            applied_cols |= {qn for qn, _, _ in relations[i].columns}
+            applied_cols |= rel_cols[i]
         for idx, on_ast, plo in pending_left:
             r = relations[idx]
-            rcols = {qn for qn, _, _ in r.columns}
+            rcols = rel_cols[idx]
             lkeys, rkeys, jres = [], [], []
             if on_ast is not None:
                 cond = self._bind_expr(

@@ -87,8 +87,9 @@ def get_refresh_time(maintenance_report_file):
 def get_throughput_time(time_log_base, num_streams, first_or_second):
     from .throughput import _ttt_from_logs
 
-    streams = {n: None for n in get_stream_range(num_streams, first_or_second)}
-    return _ttt_from_logs(streams, time_log_base)
+    return _ttt_from_logs(
+        get_stream_range(num_streams, first_or_second), time_log_base
+    )
 
 
 def get_maintenance_time(report_base, num_streams, first_or_second):

@@ -485,13 +485,24 @@ def _join_counts(rh_sorted, lhash, llive):
 
 @partial(jax.jit, static_argnames=("out_cap",))
 def _join_expand(lo, counts, rorder, out_cap):
-    """Expand (row, count) pairs into candidate (li, ri) index pairs."""
-    offs = jnp.cumsum(counts) - counts  # exclusive prefix
+    """Expand (row, count) pairs into candidate (li, ri) index pairs.
+
+    Owner assignment is scatter + blocked prefix-max, all int32: each
+    contributing row's index lands at its output-range start and cummax
+    fills the range (count>0 rows have unique starts; count-0 rows park at
+    out_cap and drop). The previous searchsorted over an int64
+    arange(out_cap) ran ~13 s at a 16M-candidate fact join on this
+    toolchain, which emulates 64-bit element types — this formulation is
+    ~50 ms at the same shape."""
+    counts = counts.astype(jnp.int32)
+    offs = (fast_cumsum(counts) - counts).astype(jnp.int32)  # exclusive
     total = jnp.sum(counts)
-    p = jnp.arange(out_cap, dtype=jnp.int64)
-    li = (jnp.searchsorted(offs + counts, p, side="right")).astype(jnp.int32)
-    li = jnp.clip(li, 0, lo.shape[0] - 1)
-    j = (p - offs[li]).astype(jnp.int32)
+    rows = jnp.arange(lo.shape[0], dtype=jnp.int32)
+    starts = jnp.where(counts > 0, offs, out_cap)
+    owner = jnp.full(out_cap, -1, jnp.int32).at[starts].max(rows, mode="drop")
+    li = jnp.clip(fast_cummax(owner), 0, lo.shape[0] - 1)
+    p = jnp.arange(out_cap, dtype=jnp.int32)
+    j = p - offs[li]
     ri_sorted_pos = jnp.clip(lo[li] + j, 0, rorder.shape[0] - 1)
     ri = rorder[ri_sorted_pos]
     pair_live = p < total

@@ -27,6 +27,10 @@ def round_up_to_nearest_10_percent(num: float) -> float:
     return math.ceil(num * 10) / 10
 
 
+class _GateBroken(RuntimeError):
+    """A stream's start-gate rendezvous failed (sibling error or timeout)."""
+
+
 def _read_start_end(time_log_path: str):
     start = end = None
     with open(time_log_path) as f:
@@ -83,7 +87,13 @@ def run_throughput(
     )
 
     def start_gate():
-        gate.wait(timeout=600)
+        try:
+            gate.wait(timeout=600)
+        except threading.BrokenBarrierError:
+            raise _GateBroken(
+                "stream start gate broken: a sibling stream failed during "
+                "setup, or setup exceeded the 600 s gate timeout"
+            ) from None
         return epoch["t"]
 
     def one_stream(n, path):
@@ -128,23 +138,24 @@ def run_throughput(
         t.join()
     if errors:
         # a pre-gate failure aborts the barrier, flooding every sibling
-        # with BrokenBarrierError; report only the root cause(s)
+        # with gate-broken errors; report only the root cause(s) unless the
+        # gate itself was the problem (pure timeout)
         real = {
-            n: e for n, e in errors.items()
-            if not isinstance(e, threading.BrokenBarrierError)
+            n: e for n, e in errors.items() if not isinstance(e, _GateBroken)
         }
         raise RuntimeError(f"throughput streams failed: {real or errors}")
     return _ttt_from_logs(stream_paths, time_log_base)
 
 
-def _ttt_from_logs(stream_paths, time_log_base) -> float:
+def _ttt_from_logs(streams, time_log_base) -> float:
     """Ttt = max(stream end) - min(stream start), rounded up to 0.1 s.
 
-    Floored at 0.1 s: the time log's int-second timestamps truncate a
-    sub-second run to 0, and Ttt feeds the composite metric's denominator
-    (nds/nds_bench.py:334-357) where 0 would poison the whole score."""
+    `streams` is any iterable of stream numbers. Floored at 0.1 s: the time
+    log's int-second timestamps truncate a sub-second run to 0, and Ttt
+    feeds the composite metric's denominator (nds/nds_bench.py:334-357)
+    where 0 would poison the whole score."""
     starts, ends = [], []
-    for n in stream_paths:
+    for n in streams:
         s, e = _read_start_end(f"{time_log_base}_{n}.csv")
         starts.append(s)
         ends.append(e)
