@@ -123,9 +123,9 @@ def bench_q3(sess, fact_rows):
 
 
 def bench_transcode():
-    """SF1 CSV -> parquet transcode rate (rows/s), one fact + one dim table
-    (bounded time; whole-warehouse rate extrapolates linearly since the
-    reader streams fixed-size morsels)."""
+    """SF1 CSV -> parquet transcode rate (rows/s) on the flagship fact
+    table, hive-partitioned by date (the BASELINE "rows/sec/chip" fact
+    path; reference metric shape: nds/nds_transcode.py:174-205)."""
     import shutil
     import tempfile
 
@@ -133,7 +133,7 @@ def bench_transcode():
     from nds_tpu.transcode import transcode_table
 
     schemas = get_schemas()
-    tables = ["store_returns", "customer"]
+    tables = ["store_sales"]
     out = tempfile.mkdtemp(prefix="nds_transcode_bench_")
     rows = 0
     try:

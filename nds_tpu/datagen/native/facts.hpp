@@ -6,6 +6,8 @@
 // distributed generation embarrassingly parallel.
 #pragma once
 
+#include <numeric>
+
 #include "dims.hpp"
 
 namespace ndsgen {
@@ -25,7 +27,27 @@ inline LineVals compute_line(const Ctx& ctx, uint64_t table, int64_t order, int 
                              bool with_ship) {
   Rng r(ctx.seed, table, order, line + 1);
   LineVals v;
-  v.item_sk = r.range(100, 1, (ctx.n_item + 1) / 2) * 2 - 1;  // odd = current SCD row
+  // Items are distinct within an order (TPC-DS PK: (item_sk, ticket/order
+  // number); dsdgen samples per-ticket items without replacement). Stateless
+  // equivalent: an order-keyed modular arithmetic progression — returns
+  // chunks re-derive the same items from (seed, table, order, line) alone.
+  {
+    const int64_t half = (ctx.n_item + 1) / 2;  // odd sks = current SCD rows
+    Rng ro(ctx.seed, table, order, 0);
+    // random start + random stride COPRIME to the domain: (s + l*t) mod H
+    // cycles through all H items, so lines are distinct whenever the
+    // order has fewer lines than items, and the marginal item
+    // distribution stays uniform over the whole domain
+    int64_t stride = 1;
+    for (uint32_t k = 0; k < 64; ++k) {
+      const int64_t t = 1 + static_cast<int64_t>(
+          ro.raw(90, k) % static_cast<uint64_t>(half > 1 ? half - 1 : 1));
+      if (std::gcd(t, half) == 1) { stride = t; break; }
+    }
+    const int64_t start = static_cast<int64_t>(ro.raw(91) % static_cast<uint64_t>(half));
+    const int64_t idx = (start + stride * line) % half;
+    v.item_sk = idx * 2 + 1;
+  }
   // dsdgen keeps nullable fact FKs ~96% populated; promo follows suit
   // (a 30% rate here made ss_promo_sk 70% null — spec-shape violation)
   v.has_promo = r.chance(101, 96);

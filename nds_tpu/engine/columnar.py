@@ -122,13 +122,18 @@ class Table:
     bench tunnel, so producers queue the count asynchronously and most
     consumers (masks, group-by, joins, sorts) never force it."""
 
-    __slots__ = ("columns", "_nrows", "live", "_packed")
+    __slots__ = ("columns", "_nrows", "live", "_packed", "unique_key")
 
-    def __init__(self, columns: dict, nrows, live=None):
+    def __init__(self, columns: dict, nrows, live=None, unique_key=None):
         self.columns = columns  # name -> Column (insertion-ordered)
         self._nrows = nrows  # host int or 0-d device array (lazy)
         self.live = live  # None (first nrows rows live) or bool[cap]
         self._packed = None  # memoized compacted() result
+        # frozenset of column names whose combined values are pairwise
+        # distinct over live rows (group-by keys, DISTINCT output). Survives
+        # row subsetting/renaming; destroyed by row-expanding gathers.
+        # Probe-style joins read it to skip runtime uniqueness checks.
+        self.unique_key = unique_key
 
     @property
     def nrows(self) -> int:
@@ -163,15 +168,23 @@ class Table:
         return self.columns[name]
 
     def select(self, names) -> "Table":
+        uk = self.unique_key
+        if uk is not None and not uk <= set(names):
+            uk = None
         return Table(
-            {n: self.columns[n] for n in names}, self._nrows, self.live
+            {n: self.columns[n] for n in names}, self._nrows, self.live,
+            unique_key=uk,
         )
 
     def rename(self, mapping: dict) -> "Table":
+        uk = self.unique_key
+        if uk is not None:
+            uk = frozenset(mapping.get(n, n) for n in uk)
         return Table(
             {mapping.get(n, n): c for n, c in self.columns.items()},
             self._nrows,
             self.live,
+            unique_key=uk,
         )
 
     def row_mask(self) -> jnp.ndarray:
@@ -202,7 +215,7 @@ class Table:
                 c.dictionary,
                 c.subset_stats(),
             )
-        self._packed = Table(cols, count)
+        self._packed = Table(cols, count, unique_key=self.unique_key)
         return self._packed
 
 

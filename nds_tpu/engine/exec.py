@@ -17,6 +17,8 @@ import jax.numpy as jnp
 import numpy as np
 import pyarrow as pa
 
+from dataclasses import replace as _dc_replace
+
 from ..dtypes import BOOL, DType, FLOAT64, INT64
 from ..ops import kernels as K
 from . import expr as E
@@ -67,6 +69,40 @@ def _resolve_bounds(datas, valids, stats_list, wanted, live):
         for i, mm in zip(need, fetched):
             bounds[i] = (int(mm[0]), int(mm[1]))
     return bounds
+
+
+def _plain_col_names(exprs, table):
+    """Column names referenced by plain Col exprs, resolved the way the
+    evaluator resolves them against `table` (qualified first, bare next)."""
+    out = set()
+    for e in exprs:
+        if isinstance(e, E.Col):
+            key = f"{e.table}.{e.name}" if e.table else e.name
+            if key not in table.columns and e.name in table.columns:
+                key = e.name
+            out.add(key)
+    return out
+
+
+def _active_key_names(key_items, key_cols):
+    """Group-by output rows are pairwise distinct over the active (non-
+    rolled-up) key columns; probe-style joins read this to skip runtime
+    uniqueness checks."""
+    return frozenset(
+        name for (_, name), c in zip(key_items, key_cols) if c is not None
+    )
+
+
+def _group_key_stats(c: "Column", n_active_keys: int):
+    """Output stats for a group-by key column: bounds are the input's
+    (group keys are a value subset); a single-key grouping's output is
+    unique by construction — which is exactly what downstream probe-style
+    joins (dense, packed) need to know to avoid a runtime uniqueness
+    check."""
+    st = c.subset_stats()
+    if st is None:
+        return None
+    return _dc_replace(st, unique=(n_active_keys == 1))
 
 
 class _DictStats:
@@ -156,8 +192,12 @@ class Executor:
     # ------------------------------------------------------------------
     def _exec_scan(self, node: P.Scan) -> Table:
         t = self.catalog.load(node.table, node.columns)
+        uk = t.unique_key
+        if uk is not None:
+            uk = frozenset(f"{node.alias}.{n}" for n in uk)
         return Table(
-            {f"{node.alias}.{n}": c for n, c in t.columns.items()}, t.nrows
+            {f"{node.alias}.{n}": c for n, c in t.columns.items()}, t.nrows,
+            unique_key=uk,
         )
 
     def _exec_materializedscan(self, node: P.MaterializedScan) -> Table:
@@ -171,13 +211,25 @@ class Executor:
         child = self.execute(node.child)
         ev = self._evaluator(child)
         cols = {}
+        renames = {}  # child column name -> output name (plain Col items)
         for e, name in node.items:
             cols[name] = ev.eval(e)
+            if isinstance(e, E.Col):
+                # mirror Evaluator._eval_col resolution order
+                key = f"{e.table}.{e.name}" if e.table else e.name
+                if key not in child.columns and e.name in child.columns:
+                    key = e.name
+                renames.setdefault(key, name)
         if not cols:
             return Table({}, child.nrows)
+        uk = child.unique_key
+        if uk is not None and all(k in renames for k in uk):
+            uk = frozenset(renames[k] for k in uk)
+        else:
+            uk = None
         # deferred-compaction mask rides through (masked rows hold garbage
         # expression values, which stay masked)
-        return Table(cols, child.nrows_lazy, live=child.live)
+        return Table(cols, child.nrows_lazy, live=child.live, unique_key=uk)
 
     def _exec_filter(self, node: P.Filter) -> Table:
         child = self.execute(node.child)
@@ -576,8 +628,10 @@ class Executor:
         lcols = [lev.eval(e) for e in left_keys]
         rcols = [rev.eval(e) for e in right_keys]
         lk, lv, rk, rv = [], [], [], []
+        aligned = []  # (left Column, right Column) pairs, dtype-unified
         for a, b in zip(lcols, rcols):
             for ca, cb in zip(*self._join_key_pair(a, b)):
+                aligned.append((ca, cb))
                 lk.append(ca.data)
                 lv.append(ca.valid)
                 rk.append(cb.data)
@@ -592,6 +646,12 @@ class Executor:
             return fast
         fast = self._try_exchange_join(
             left, right, kind, lk, lv, rk, rv, llive, rlive, residual
+        )
+        if fast is not None:
+            return fast
+        fast = self._try_packed_join(
+            left, right, kind, aligned, right_keys, llive, rlive, residual,
+            mark_name,
         )
         if fast is not None:
             return fast
@@ -728,17 +788,27 @@ class Executor:
         matched, ri = K.dense_probe(
             lk[0].astype(jnp.int64), lnn, rmin, presence, rows, table_cap
         )
+        return self._augment_join_output(
+            left, right, kind, matched, ri, llive, residual, mark_name
+        )
+
+    def _augment_join_output(
+        self, left, right, kind, matched, ri, llive, residual, mark_name,
+    ):
+        """Left-aligned join output for probe-style paths (dense, packed):
+        matched rows live in place, right columns gathered alongside — no
+        count sync, no compaction gathers."""
         if kind in ("semi", "anti", "mark"):
             if kind == "mark":
                 out_cols = dict(left.columns)
                 out_cols[mark_name] = Column(matched, BOOL)
-                return Table(out_cols, left.nrows_lazy, live=left.live)
+                return Table(
+                    out_cols, left.nrows_lazy, live=left.live,
+                    unique_key=left.unique_key,
+                )
             mask = (matched if kind == "semi" else ~matched) & llive
             return self._masked(left, mask)
         if kind == "inner":
-            # masked left-aligned output: no count sync, no compaction
-            # gathers — the probe result IS the pair table (matched rows
-            # live in place, right columns gathered alongside)
             out_cols = dict(left.columns)
             ri_safe = jnp.where(matched, ri, 0)
             for name, c in right.columns.items():
@@ -749,7 +819,7 @@ class Executor:
                 )
             pair = Table(
                 dict(out_cols), jnp.sum(matched, dtype=jnp.int32),
-                live=matched,
+                live=matched, unique_key=left.unique_key,
             )
             if residual is not None:
                 return self._masked(
@@ -765,7 +835,86 @@ class Executor:
                 c.data[ri_safe], c.dtype, valid & matched, c.dictionary,
                 c.gather_stats(),
             )
-        return Table(out_cols, left.nrows_lazy, live=left.live)
+        return Table(
+            out_cols, left.nrows_lazy, live=left.live,
+            unique_key=left.unique_key,
+        )
+
+    # -- packed-word sort-lookup join ------------------------------------
+    # Exact int64 packing of the (possibly composite) join key using host-
+    # known bounds (ColStats riding on columns, dictionary sizes for
+    # strings): collision-free by construction, so membership needs no
+    # verification and no candidate expansion. semi/anti/mark become a
+    # sort + lookup regardless of right-side multiplicity; inner/left take
+    # the same left-aligned augment output as the dense path when the
+    # right side is known-unique on the join key from plan metadata
+    # (Table.unique_key, set by group-by/distinct outputs). Zero device
+    # syncs either way. The cuDF analogue is the mixed-join distinct-hash-
+    # join split; this is its sort-based TPU shape.
+
+    def _pack_key_words(self, aligned):
+        """Exact int64 word per side for aligned join-key Column pairs, or
+        None when bounds are unknown or exceed 62 bits (the packing itself
+        is K.pack_key_words, shared with the catalog's PK verification).
+        Nulls never match anyway — masked by not-null liveness — but the
+        dedicated 0 slot keeps dead-row words in range."""
+        bounds = []
+        for ca, cb in aligned:
+            if ca.dtype.is_string and cb.dtype.is_string:
+                if ca.dictionary is None or cb.dictionary is None:
+                    return None
+                if ca.dictionary is not cb.dictionary:
+                    return None  # _join_key_pair unifies; anything else bails
+                bounds.append((0, max(len(ca.dictionary) - 1, 0)))
+            elif ca.dtype.kind in ("int32", "int64", "date") and cb.dtype.kind in (
+                "int32", "int64", "date",
+            ):
+                sa, sb = ca.subset_stats(), cb.subset_stats()
+                if sa is None or sb is None:
+                    return None
+                bounds.append(
+                    (min(sa.vmin, sb.vmin), max(sa.vmax, sb.vmax))
+                )
+            else:
+                return None
+        return K.pack_key_words(
+            [
+                [(ca.data, ca.valid) for ca, _ in aligned],
+                [(cb.data, cb.valid) for _, cb in aligned],
+            ],
+            bounds,
+        )
+
+    def _try_packed_join(
+        self, left, right, kind, aligned, right_keys, llive, rlive,
+        residual, mark_name,
+    ):
+        if not aligned:
+            return None
+        if kind not in ("inner", "left", "semi", "anti", "mark"):
+            return None
+        if kind in ("semi", "anti", "mark", "left") and residual is not None:
+            return None
+        if kind in ("inner", "left"):
+            # the augment output keeps one row per left row, so the right
+            # side must be known-unique on the join key (plan metadata from
+            # group-by/distinct); duplicated right keys are the general
+            # sort join's business. Checked from metadata, never probed at
+            # runtime — a wasted sort + sync on the fallback path costs
+            # more than the fast path saves.
+            uk = right.unique_key
+            if uk is None or not uk <= _plain_col_names(right_keys, right):
+                return None
+        words = self._pack_key_words(aligned)
+        if words is None:
+            return None
+        lwords, rwords = words
+        lnn = K._all_valid([c.valid for c, _ in aligned], llive)
+        rnn = K._all_valid([c.valid for _, c in aligned], rlive)
+        found, ri = K.member_lookup(lwords, lnn, rwords, rnn)
+        return self._augment_join_output(
+            left, right, kind, found, ri, llive, residual, mark_name
+        )
 
     # -- distributed fact-fact hash join ---------------------------------
     # When both inner-join inputs are large under a mesh, neither fits the
@@ -934,10 +1083,20 @@ class Executor:
                 return [Column(ew, INT64, f.valid), Column(mw, INT64, f.valid)]
 
             return as_keys(a), as_keys(b)
-        return (
-            [_cast_column(a, INT64, a.data.shape[0])],
-            [_cast_column(b, INT64, b.data.shape[0])],
-        )
+
+        def as_i64(c):
+            out = _cast_column(c, INT64, c.data.shape[0])
+            if (
+                out.stats is None
+                and c.stats is not None
+                and c.dtype.kind in ("int32", "int64", "date", "bool")
+            ):
+                # value-preserving widening: bounds and uniqueness survive,
+                # and the packed-join path depends on them downstream
+                out = _dc_replace(out, stats=c.subset_stats())
+            return out
+
+        return [as_i64(a)], [as_i64(b)]
 
     def _pair_table(self, left, right, li, ri, nrows, rnull, lnull=None):
         # join-output gather can repeat rows: bounds survive, uniqueness dies
@@ -973,6 +1132,7 @@ class Executor:
         li = jnp.clip(li, 0, max(left.cap - 1, 0))
         return self._pair_table(left, right, li, ri, total, None)
 
+    # ------------------------------------------------------------------
     # ------------------------------------------------------------------
     def _exec_aggregate(self, node: P.Aggregate) -> Table:
         child, live, nlive = self._agg_input(node)
@@ -1157,13 +1317,16 @@ class Executor:
                 value = kmin + code
             out_dtype = c.dtype.device_np_dtype()
             data = value.astype(out_dtype)
-            cols[name] = Column(data, c.dtype, valid, c.dictionary)
+            cols[name] = Column(
+                data, c.dtype, valid, c.dictionary,
+                _group_key_stats(c, len(active)),
+            )
         for agg, name in agg_items:
             cols[name] = self._eval_agg(
                 agg, ev, None, gid_dense, gcap, live, ngroups, child, subset,
                 key_cols,
             )
-        return Table(cols, ngroups)
+        return Table(cols, ngroups, unique_key=_active_key_names(key_items, key_cols))
 
     def _agg_output(
         self, child, key_items, key_cols, agg_items, subset,
@@ -1200,13 +1363,18 @@ class Executor:
             else:
                 data = c.data[first_rows]
                 valid = None if c.valid is None else c.valid[first_rows]
-                cols[name] = Column(data, c.dtype, valid, c.dictionary)
+                cols[name] = Column(
+                    data, c.dtype, valid, c.dictionary,
+                    _group_key_stats(
+                        c, sum(1 for kc in key_cols if kc is not None)
+                    ),
+                )
         for agg, name in agg_items:
             cols[name] = self._eval_agg(
                 agg, ev, order, gid, gcap, live_sorted, ngroups, child, subset,
                 key_cols, key_words,
             )
-        return Table(cols, ngroups)
+        return Table(cols, ngroups, unique_key=_active_key_names(key_items, key_cols))
 
     def _eval_agg(
         self, agg: E.Agg, ev, order, gid, gcap, live_sorted, ngroups, child,
@@ -1696,7 +1864,8 @@ class Executor:
         column). Downstream operators consume row_mask() directly; packing
         happens lazily at collect()/limit via Table.compacted()."""
         return Table(
-            dict(table.columns), jnp.sum(mask, dtype=jnp.int32), live=mask
+            dict(table.columns), jnp.sum(mask, dtype=jnp.int32), live=mask,
+            unique_key=table.unique_key,
         )
 
     def _compact(self, table: Table, mask) -> Table:
@@ -1727,7 +1896,9 @@ class Executor:
         gcap = bucket_cap(max(ng, 1))
         first = K.segment_starts(gid, gcap)
         rows = order[jnp.clip(first, 0, t.cap - 1)]
-        return self._take(t, rows, ng)
+        out = self._take(t, rows, ng)
+        out.unique_key = frozenset(out.columns)
+        return out
 
     def _concat(self, a: Table, b: Table) -> Table:
         """Masked concatenation: columns append at full capacity (padded to

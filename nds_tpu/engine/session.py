@@ -117,6 +117,12 @@ class _Entry:
         self.fmt = fmt  # parquet | csv | orc
         self.device_cols = {}  # per-column device cache: name -> Column
         self.nrows = None
+        # declared-PK verification memo: None = not checked yet, else bool.
+        # The TABLE_PRIMARY_KEYS claim is about the DATA, and a table
+        # registered under a TPC-DS name may hold anything (synthetic test
+        # tables) — so the claim is checked against the actual rows once
+        # before any join relies on it.
+        self.pk_verified = None
 
 
 class Catalog:
@@ -271,7 +277,16 @@ class Catalog:
             # all requested columns cached but nrows unset (can't happen in
             # practice; guard for empty column list)
             e.nrows = 0
-        return Table({c: e.device_cols[c] for c in columns}, e.nrows)
+        from ..schema import TABLE_PRIMARY_KEYS
+
+        out = Table({c: e.device_cols[c] for c in columns}, e.nrows)
+        pk = TABLE_PRIMARY_KEYS.get(name)
+        if pk is not None and all(c in columns for c in pk):
+            if e.pk_verified is None:
+                e.pk_verified = _pk_holds(out, pk)
+            if e.pk_verified:
+                out.unique_key = frozenset(pk)
+        return out
 
     def _to_device(self, name, arrow, e: _Entry):
         t = table_from_arrow(arrow, e.schema, with_stats=True)
@@ -339,6 +354,9 @@ class Catalog:
         if e is not None:
             e.device_cols = {}
             e.nrows = None
+            # DML may have broken (or restored) the declared PK; re-verify
+            # on next load before any join trusts the uniqueness claim
+            e.pk_verified = None
 
 
 class Result:
@@ -549,6 +567,34 @@ class Session:
 # ---------------------------------------------------------------------------
 # Projection pruning: annotate Scans with the minimal column set
 # ---------------------------------------------------------------------------
+
+
+def _pk_holds(t, pk) -> bool:
+    """One-time device check that the declared primary key is actually
+    unique in this table's data (exact packed words via the same
+    K.pack_key_words the join probes use, sort, adjacent compare; one host
+    sync, memoized per catalog entry by the caller). Conservative False
+    when columns aren't packable ints with stats."""
+    import jax.numpy as jnp
+
+    from ..ops import kernels as K
+
+    cols = [t.columns[c] for c in pk]
+    if any(
+        c.dtype.is_string or c.dtype.is_decimal or c.stats is None
+        for c in cols
+    ):
+        return False
+    words = K.pack_key_words(
+        [[(c.data, c.valid) for c in cols]],
+        [(c.stats.vmin, c.stats.vmax) for c in cols],
+    )
+    if words is None:
+        return False
+    big = jnp.iinfo(jnp.int64).max
+    w = jnp.where(t.row_mask(), words[0], big)
+    ws = w[K.kv_sort_perm(w)]
+    return not bool(jnp.any((ws[1:] == ws[:-1]) & (ws[1:] != big)))
 
 
 def prune_columns(node: P.PlanNode, catalog=None) -> P.PlanNode:

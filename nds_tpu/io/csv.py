@@ -64,6 +64,23 @@ def read_dat_dir(path, schema, use_decimal=True) -> pa.Table:
     return pa.concat_tables(parts)
 
 
+def iter_dat_chunk_tables(path, schema, use_decimal=True):
+    """Yield one whole Arrow table per generator chunk file (or the single
+    file). Host memory is bounded by the chunk size, which generation
+    parallelism keeps roughly constant across scale factors; the
+    partitioned transcode writer sorts each chunk by its partition key, so
+    it needs chunk granularity rather than fixed-byte morsels."""
+    files = (
+        [path]
+        if os.path.isfile(path)
+        else sorted(glob.glob(os.path.join(path, "*.dat")))
+    )
+    if not files:
+        raise FileNotFoundError(f"no .dat files under {path}")
+    for f in files:
+        yield read_dat_file(f, schema, use_decimal)
+
+
 def iter_dat_batches(path, schema, use_decimal=True, block_size=64 << 20):
     """Stream a .dat file or chunk directory as Arrow record batches.
 

@@ -537,6 +537,62 @@ def _all_valid(valids, live):
     return m
 
 
+def pack_key_words(sides, bounds):
+    """Pack N aligned integer key columns into one exact int64 word per
+    side. `sides` is a list of column lists (one list per side, each
+    [(data, valid)] of equal length N); `bounds` is [(vmin, vmax)] per key
+    (host ints, union over all sides). Layout per key: (value - vmin + 1)
+    in its bit field, 0 for NULL. Returns one word array per side, or None
+    when the packed width exceeds 62 bits. The single definition keeps the
+    catalog's PK verification and the executor's packed join bit-for-bit
+    identical."""
+    shift = 0
+    words = [None] * len(sides)
+    for ki, (vmin, vmax) in enumerate(bounds):
+        span = vmax - vmin + 2  # +1 for the NULL slot
+        bits = max(1, (span - 1).bit_length())
+        if shift + bits > 62:
+            return None
+        for si, side in enumerate(sides):
+            data, valid = side[ki]
+            v = data.astype(I64) - vmin + 1
+            if valid is not None:
+                v = jnp.where(valid, v, 0)
+            part = v << shift
+            words[si] = part if words[si] is None else words[si] + part
+        shift += bits
+    return words
+
+
+def member_lookup(lwords, lnn, rwords, rnn):
+    """Exact-word membership probe: for each left row, is its packed key
+    word present among live right words, and at which right row?
+
+    Requires collision-free words (exact packing, not hashing) — presence
+    needs no verification and right-side duplicates cannot hide a match
+    (`ri` is then the first duplicate in sorted order; callers needing a
+    unique right side must know it from plan metadata). The sort runs
+    eagerly through the shared canonical kv-sort so its per-shape compile
+    is amortized with every other sorting consumer."""
+    big = jnp.iinfo(I64).max
+    rw = jnp.where(rnn, rwords, big)
+    order = _kv_sort_perm(rw)
+    return _member_probe(rw[order], order, lwords, lnn)
+
+
+@partial(jax.jit, static_argnames=())
+def _member_probe(rw_sorted, order, lwords, lnn):
+    n = rw_sorted.shape[0]
+    probe = jnp.where(lnn, lwords, jnp.int64(-1))
+    lo = jnp.clip(
+        jnp.searchsorted(rw_sorted, probe, side="left"), 0, n - 1
+    ).astype(jnp.int32)
+    # packed words are non-negative, so the -1 dead-left probe never hits
+    found = lnn & (rw_sorted[lo] == probe)
+    ri = order[lo]
+    return found, ri
+
+
 @partial(jax.jit, static_argnames=())
 def verify_pairs(li, ri, pair_live, lkeys, lvalids, llive, rkeys, rvalids, rlive):
     """AND real key equality into the candidate mask (collision shield)."""

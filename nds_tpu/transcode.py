@@ -127,6 +127,19 @@ def transcode_table(
 
     part_col = TABLE_PARTITIONING.get(table) if partition else None
 
+    if part_col is not None and output_format == "parquet":
+        # hive layout <col>=<value>/ — one directory per date key, matching
+        # the reference's partitionBy(date_sk) warehouse layout. Written
+        # directly (sort each generator chunk by the key, slice runs into
+        # one persistent ParquetWriter per partition) instead of
+        # pads.write_dataset: the dataset writer's per-batch partition
+        # fanout ran ~10x slower than an unpartitioned write on this
+        # 1-core host (the round-4 24.7k rows/s transcode bottleneck).
+        return _write_hive_partitioned_parquet(
+            src, dst, schema, arrow_schema, part_col, use_decimal,
+            compression or "snappy", basename,
+        )
+
     write_opts = {}
     if output_format == "parquet":
         fmt = pads.ParquetFileFormat()
@@ -136,8 +149,6 @@ def transcode_table(
 
     kwargs = {}
     if part_col is not None:
-        # hive layout <col>=<value>/ — one directory per date key, matching
-        # the reference's partitionBy(date_sk) warehouse layout
         kwargs["partitioning"] = pads.partitioning(
             pa.schema([arrow_schema.field(part_col)]), flavor="hive"
         )
@@ -153,6 +164,85 @@ def transcode_table(
         existing_data_behavior="overwrite_or_ignore",
         **kwargs,
     )
+    return rows
+
+
+def _write_hive_partitioned_parquet(
+    src, dst, schema, arrow_schema, part_col, use_decimal, compression,
+    basename,
+):
+    """Fact-table hive-partitioned write: one ParquetWriter per partition
+    directory held open across generator chunks (one output file per
+    partition, like the reference's one-shuffle-partition-per-date layout);
+    each chunk is sorted by the key once and sliced into zero-copy runs.
+    Returns rows written."""
+    import numpy as np
+    import pyarrow.compute as pc
+    import pyarrow.parquet as pq
+
+    from .io.csv import iter_dat_chunk_tables
+
+    file_schema = pa.schema(
+        [f for f in arrow_schema if f.name != part_col]
+    )
+    writers = {}   # dirname -> open ParquetWriter (LRU by re-insertion)
+    fileno = {}    # dirname -> next file sequence number
+    rows = 0
+    # bound simultaneously-open files by the process fd limit: ~1800 date
+    # partitions fit comfortably under this host's limit (one file per
+    # partition, the reference's one-shuffle-partition-per-date layout);
+    # on an fd-constrained host evicted partitions re-open as a new part
+    import resource
+
+    soft, _ = resource.getrlimit(resource.RLIMIT_NOFILE)
+    max_open = max(64, min(8192, soft - 128))
+    try:
+        for chunk in iter_dat_chunk_tables(src, schema, use_decimal):
+            if chunk.num_rows == 0:
+                continue
+            rows += chunk.num_rows
+            order = pc.sort_indices(chunk, sort_keys=[(part_col, "ascending")])
+            chunk = chunk.take(order)
+            keys = chunk.column(part_col)
+            vals = keys.to_numpy(zero_copy_only=False)
+            # run boundaries over the sorted key (NaN run = nulls, at end)
+            fv = vals.astype(np.float64)
+            change = np.nonzero(
+                np.diff(fv) != 0
+            )[0] + 1  # NaN != NaN, so each null "changes"; regrouped below
+            starts = np.concatenate([[0], change])
+            null_start = None
+            if keys.null_count:
+                null_start = len(vals) - keys.null_count
+                starts = starts[starts <= null_start]
+                if starts[-1] != null_start:
+                    starts = np.concatenate([starts, [null_start]])
+            bounds = np.concatenate([starts, [len(vals)]])
+            body = chunk.drop_columns([part_col])
+            for s, e2 in zip(bounds[:-1], bounds[1:]):
+                if null_start is not None and s == null_start:
+                    dirname = "__HIVE_DEFAULT_PARTITION__"
+                else:
+                    dirname = str(int(vals[s]))
+                w = writers.pop(dirname, None)
+                if w is None:
+                    if len(writers) >= max_open:
+                        evict, wv = next(iter(writers.items()))
+                        del writers[evict]
+                        wv.close()
+                    pdir = os.path.join(dst, f"{part_col}={dirname}")
+                    os.makedirs(pdir, exist_ok=True)
+                    seq = fileno.get(dirname, 0)
+                    fileno[dirname] = seq + 1
+                    w = pq.ParquetWriter(
+                        os.path.join(pdir, basename.format(i=seq)),
+                        file_schema, compression=compression,
+                    )
+                writers[dirname] = w  # (re)insert at LRU tail
+                w.write_table(body.slice(s, e2 - s))
+    finally:
+        for w in writers.values():
+            w.close()
     return rows
 
 

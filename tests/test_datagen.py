@@ -341,3 +341,24 @@ def test_official_dsdgen_format_ingests(tmp_path):
     n = transcode_table(str(tmp_path), str(out), "warehouse", schema,
                         output_format="parquet", partition=False)
     assert n == 3
+
+
+def test_fact_primary_keys_unique(datadir):
+    """Declared TPC-DS primary keys hold in generated data (dsdgen samples
+    items per ticket/order without replacement). The engine's catalog
+    claims these as Table.unique_key for probe-style joins, so a violation
+    here would silently corrupt join results, not just fidelity."""
+    import numpy as np
+
+    from nds_tpu.schema import TABLE_PRIMARY_KEYS
+
+    schemas = get_schemas()
+    for t in ("store_sales", "web_sales", "catalog_sales", "store_returns",
+              "web_returns", "catalog_returns", "inventory"):
+        pk = TABLE_PRIMARY_KEYS[t]
+        tab = read_table(datadir, t, schemas[t])
+        m = np.stack(
+            [tab.column(c).to_numpy(zero_copy_only=False).astype(np.int64)
+             for c in pk], 1,
+        )
+        assert len(np.unique(m, axis=0)) == tab.num_rows, t

@@ -21,12 +21,100 @@ from nds_tpu.schema import get_schemas
 DATA = "/tmp/nds_test_sf001"
 TABLES = ("store_sales", "store_returns", "item", "date_dim", "store", "customer")
 
-# sqlite has no GROUPING SETS, so ROLLUP/GROUPING templates are validated
-# by the engine-vs-engine paths instead (dist oracle, row bounds). Every
-# other dialect difference is lowered by _to_sqlite below (interval
-# arithmetic, typed date literals, date casts) or bridged by a registered
-# Python aggregate (stddev_samp).
-_SQLITE_INCOMPATIBLE = ("rollup", "grouping")
+# Every dialect difference is lowered by _to_sqlite below (ROLLUP ->
+# UNION ALL of GROUP BY prefixes, interval arithmetic, typed date
+# literals, date casts) or bridged by a registered Python aggregate
+# (stddev_samp), so the list of templates the independent oracle cannot
+# express is empty.
+_SQLITE_INCOMPATIBLE = ()
+
+
+def _depth_profile(s: str):
+    """Paren depth at every index of s."""
+    out = []
+    d = 0
+    for c in s:
+        if c == "(":
+            d += 1
+        elif c == ")":
+            d -= 1
+        out.append(d)
+    return out
+
+
+def _lower_rollup(sql: str) -> str:
+    """GROUP BY ROLLUP(k1..kk) -> UNION ALL of the k+1 GROUP BY prefixes,
+    with rolled-away keys replaced by NULL and grouping(ki) by 0/1 in the
+    select list (sqlite has no GROUPING SETS). Keys are plain identifiers
+    in every TPC-DS rollup template; windows partitioned by grouping()
+    levels stay correct because each branch is exactly one level, so no
+    window partition ever spans branches."""
+    import re
+
+    low = sql.lower()
+    m = re.search(r"group\s+by\s+rollup\s*\(", low)
+    if m is None:
+        return sql
+    depth = _depth_profile(low)
+    gdepth = depth[m.start()]
+    kstart = low.index("(", m.start())
+    kend = kstart
+    while not (low[kend] == ")" and depth[kend] == gdepth):
+        kend += 1
+    keys = [k.strip() for k in sql[kstart + 1:kend].split(",")]
+
+    sel = None  # owning SELECT: last same-depth 'select' before the rollup
+    for sm in re.finditer(r"\bselect\b", low):
+        if sm.start() < m.start() and depth[sm.start()] == gdepth:
+            sel = sm.start()
+    assert sel is not None
+
+    # end of the rollup SELECT block: closing paren of the enclosing
+    # subquery, or a same-depth ORDER BY / LIMIT, or end of statement
+    end = len(sql)
+    j = kend + 1
+    while j < len(sql):
+        if low[j] == ")" and depth[j] < gdepth:
+            end = j
+            break
+        if depth[j] == gdepth and re.match(r"order\s+by\b|limit\b", low[j:]):
+            end = j
+            break
+        j += 1
+    assert sql[kend + 1:end].strip() == "", (
+        "unsupported clause between ROLLUP and block end",
+        sql[kend + 1:end],
+    )
+
+    head = sql[sel:m.start()]  # 'select ... from ... where ...'
+    hlow = head.lower()
+    hdepth = _depth_profile(hlow)
+    fpos = next(
+        fm.start()
+        for fm in re.finditer(r"\bfrom\b", hlow)
+        if hdepth[fm.start()] == 0
+    )
+    select_list = head[len("select"):fpos]
+    from_where = head[fpos:]
+
+    branches = []
+    for p in range(len(keys), -1, -1):
+        sl = select_list
+        for ki, k in enumerate(keys):
+            g = "0" if ki < p else "1"
+            sl = re.sub(
+                rf"grouping\s*\(\s*{re.escape(k)}\s*\)", g, sl, flags=re.I
+            )
+        for k in keys[p:]:
+            sl = re.sub(rf"\b{re.escape(k)}\b", "null", sl, flags=re.I)
+        gb = f" group by {', '.join(keys[:p])}" if p else ""
+        branches.append(f"select {sl} {from_where}{gb}")
+    union = " union all ".join(branches)
+    if end < len(sql) and sql[end] == ")":
+        lowered = sql[:sel] + union + sql[end:]
+    else:
+        lowered = sql[:sel] + f"select * from ({union}) " + sql[end:]
+    return _lower_rollup(lowered)  # a script part may hold several rollups
 
 
 def _to_sqlite(sql: str) -> str:
@@ -34,6 +122,8 @@ def _to_sqlite(sql: str) -> str:
     ISO strings in the sqlite tables, so date(...) results (also ISO
     strings) compare lexicographically == chronologically."""
     import re
+
+    sql = _lower_rollup(sql)
 
     # cast(expr as date) -> date(expr); sqlite CAST has numeric affinity
     # ('2000-01-01' AS DATE -> 2000), date() normalizes ISO strings
