@@ -27,8 +27,14 @@ The OUT object is self-describing (per-run evidence, not just the headline):
 `failed` {name: error text} ride along with the geomean so a killed or
 failed run still leaves per-query times and failure reasons in the artifact.
 
+After the SF1 stream, a secondary `sf10` block records the same metrics at
+NDS scale factor 10 (wall-budgeted, fail-soft), and `sqlite_anchor` embeds
+the external sqlite baseline over the identical SF1 stream (computed
+offline by tools/sqlite_anchor.py into anchors/sqlite_sf1.json).
+
 Env knobs: NDS_BENCH_SCALE (default 1), NDS_BENCH_DATA,
-NDS_BENCH_SKIP_GEOMEAN, NDS_BENCH_SKIP_TRANSCODE, NDS_BENCH_QUERY_TIMEOUT,
+NDS_BENCH_SKIP_GEOMEAN, NDS_BENCH_SKIP_TRANSCODE, NDS_BENCH_SKIP_SF10,
+NDS_BENCH_SF10_BUDGET (s), NDS_BENCH_QUERY_TIMEOUT,
 NDS_BENCH_QUERY_SUBSET (comma-separated query names, debug aid).
 """
 
@@ -87,16 +93,18 @@ def _on_term(signum, frame):
     os._exit(0)
 
 
-def ensure_data():
-    marker = os.path.join(DATA_DIR, ".complete")
+def ensure_data(scale=None, data_dir=None, parallel=4):
+    scale = SCALE if scale is None else scale
+    data_dir = DATA_DIR if data_dir is None else data_dir
+    marker = os.path.join(data_dir, ".complete")
     if os.path.exists(marker):
         return
     here = os.path.dirname(os.path.abspath(__file__))
     subprocess.run(
         [
             sys.executable, "-m", "nds_tpu.cli.gen_data",
-            "--scale", str(SCALE), "--parallel", "4",
-            "--data_dir", DATA_DIR, "--overwrite_output",
+            "--scale", str(scale), "--parallel", str(parallel),
+            "--data_dir", data_dir, "--overwrite_output",
         ],
         check=True,
         cwd=here,
@@ -122,10 +130,10 @@ def bench_q3(sess, fact_rows):
     return fact_rows / statistics.median(times)
 
 
-def bench_transcode():
-    """SF1 CSV -> parquet transcode rate (rows/s) on the flagship fact
-    table, hive-partitioned by date (the BASELINE "rows/sec/chip" fact
-    path; reference metric shape: nds/nds_transcode.py:174-205)."""
+def bench_transcode(data_dir=None):
+    """CSV -> parquet transcode rate (rows/s) on the flagship fact table,
+    hive-partitioned by date (the BASELINE "rows/sec/chip" fact path;
+    reference metric shape: nds/nds_transcode.py:174-205)."""
     import shutil
     import tempfile
 
@@ -140,8 +148,8 @@ def bench_transcode():
         t0 = time.perf_counter()
         for t in tables:
             rows += transcode_table(
-                DATA_DIR, out, t, schemas[t], output_format="parquet",
-                output_mode="overwrite",
+                data_dir or DATA_DIR, out, t, schemas[t],
+                output_format="parquet", output_mode="overwrite",
             )
         dt = time.perf_counter() - t0
     finally:
@@ -149,16 +157,22 @@ def bench_transcode():
     return rows / dt
 
 
-def bench_geomean(sess):
+def bench_geomean(sess, block=None, scale=None, wall_budget=None):
     """Steady-state per-query seconds over stream 0 of every template.
-    Updates OUT and re-emits after every query (fail-soft)."""
+    Writes into `block` (default: OUT itself) and re-emits after every
+    query (fail-soft). `wall_budget` seconds, if set, stops the loop early
+    with a truncation marker (the secondary-scale block must not starve
+    the driver's overall budget)."""
     import tempfile
 
     from nds_tpu.datagen.query_streams import generate_streams
     from nds_tpu.power import gen_sql_from_stream
 
+    block = OUT if block is None else block
+    scale = SCALE if scale is None else scale
+    wall_start = time.monotonic()
     with tempfile.TemporaryDirectory() as d:
-        generate_streams(d, 1, SCALE, rngseed=19620718)
+        generate_streams(d, 1, scale, rngseed=19620718)
         queries = gen_sql_from_stream(os.path.join(d, "query_0.sql"))
     subset = os.environ.get("NDS_BENCH_QUERY_SUBSET")
     if subset:
@@ -232,24 +246,28 @@ def bench_geomean(sess):
                 sum(math.log(max(v["steady"], 1e-4)) for v in detail.values())
                 / len(detail)
             )
-            OUT["geomean_query_sec"] = round(geo, 4)
-        OUT["geomean_queries"] = len(detail)
-        OUT["per_query"] = {
+            block["geomean_query_sec"] = round(geo, 4)
+        block["geomean_queries"] = len(detail)
+        block["per_query"] = {
             n: {"cold": round(v["cold"], 2), "steady": round(v["steady"], 3)}
             for n, v in detail.items()
         }
-        OUT["slowest5"] = [
+        block["slowest5"] = [
             [n, round(v["steady"], 2)]
             for n, v in sorted(
                 detail.items(), key=lambda kv: -kv[1]["steady"]
             )[:5]
         ]
         if failed:
-            OUT["failed_queries"] = sorted(failed)
-            OUT["failed"] = {n: e[:500] for n, e in failed.items()}
+            block["failed_queries"] = sorted(failed)
+            block["failed"] = {n: e[:500] for n, e in failed.items()}
         emit()
 
     for i, (name, q) in enumerate(queries.items()):
+        if wall_budget is not None and time.monotonic() - wall_start > wall_budget:
+            block["truncated_after"] = i
+            emit()
+            break
         try:
             t0 = time.perf_counter()
             status = run_with_timeout(q, per_query_budget)
@@ -292,9 +310,34 @@ def bench_geomean(sess):
             update_out()
 
 
+def load_sqlite_anchor():
+    """Embed the offline-computed external sqlite baseline (same data, same
+    stream, same host — tools/sqlite_anchor.py) so the engine geomean in
+    this artifact always sits next to an independent engine's number."""
+    p = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "anchors",
+        "sqlite_sf1.json",
+    )
+    try:
+        with open(p) as f:
+            a = json.load(f)
+    except Exception:
+        # the anchor is an optional embellishment: a missing or truncated
+        # file must never break the fail-soft artifact contract
+        return
+    OUT["sqlite_anchor"] = {
+        k: a.get(k)
+        for k in (
+            "engine", "geomean_completed_sec", "completed",
+            "timeout_or_failed", "per_query_budget_s",
+        )
+    }
+
+
 def main():
     signal.signal(signal.SIGTERM, _on_term)
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    load_sqlite_anchor()
     ensure_data()
 
     from nds_tpu.engine.session import Session
@@ -325,6 +368,40 @@ def main():
     if not os.environ.get("NDS_BENCH_SKIP_GEOMEAN"):
         bench_geomean(sess)
     emit()
+
+    if not os.environ.get("NDS_BENCH_SKIP_SF10") and SCALE == 1.0:
+        try:
+            bench_sf10(sess)
+        except Exception as exc:
+            OUT.setdefault("sf10", {})["error"] = str(exc)[:500]
+        emit()
+
+
+def bench_sf10(sess_sf1):
+    """Secondary block at SF10 (BASELINE ladder: the next rung after SF1;
+    store_sales = 28.8M rows — fits HBM, stresses every capacity
+    heuristic). Fail-soft into OUT['sf10']. The query loop is wall-
+    budgeted; datagen and the transcode measurement before it are bounded
+    by data size (~15 min on the 1-core host), and a SIGTERM at any point
+    still flushes whatever the block has recorded so far."""
+    from nds_tpu.engine.session import Session
+    from nds_tpu.schema import get_schemas
+
+    block = OUT.setdefault("sf10", {})
+    data_dir = "/tmp/nds_bench_sf10.0"
+    ensure_data(scale=10, data_dir=data_dir, parallel=8)
+    block["transcode_rows_per_sec"] = round(bench_transcode(data_dir))
+    emit()
+    # free the SF1 session's device residency before loading SF10 tables
+    sess_sf1.recover_memory("switching to SF10 data")
+    sess = Session()
+    schemas = get_schemas()
+    for t, schema in schemas.items():
+        path = os.path.join(data_dir, t)
+        if os.path.isdir(path):
+            sess.register_csv_dir(t, path, schema)
+    budget = int(os.environ.get("NDS_BENCH_SF10_BUDGET", "2700"))
+    bench_geomean(sess, block=block, scale=10, wall_budget=budget)
 
 
 if __name__ == "__main__":

@@ -283,7 +283,15 @@ class Catalog:
         pk = TABLE_PRIMARY_KEYS.get(name)
         if pk is not None and all(c in columns for c in pk):
             if e.pk_verified is None:
-                e.pk_verified = _pk_holds(out, pk)
+                if len(pk) == 1:
+                    # single-column PK: ingest-time host stats already
+                    # know distinctness — zero device work
+                    st = out.columns[pk[0]].stats
+                    e.pk_verified = bool(st is not None and st.unique)
+                else:
+                    # composite PK (the 7 fact tables): one-time device
+                    # sort + sync, memoized until DML invalidates
+                    e.pk_verified = _pk_holds(out, pk)
             if e.pk_verified:
                 out.unique_key = frozenset(pk)
         return out
@@ -484,9 +492,12 @@ class Session:
             if maintenance
             else get_schemas(self.use_decimal)
         )
+        from ..io.fs import get_fs, join as fs_join
+
+        fs, _ = get_fs(data_root)
         for tname, schema in schemas.items():
-            path = os.path.join(data_root, tname)
-            if os.path.exists(path):
+            path = fs_join(data_root, tname)
+            if fs.exists(get_fs(path)[1]):
                 self.catalog.entries[tname] = _Entry(
                     schema=schema, path=path, fmt=fmt
                 )

@@ -277,6 +277,11 @@ def _run_call(session, stmt: A.CallStmt):
 
 
 def _to_ts_ms(ts) -> int:
+    if isinstance(ts, str):
+        try:
+            ts = float(ts)  # CLI args arrive as strings
+        except ValueError:
+            pass
     if isinstance(ts, (int, float)):
         # numeric: epoch seconds (fractional ok) or ms if large
         return int(ts if ts > 10**12 else ts * 1000)

@@ -11,22 +11,28 @@ provides them with immutable parquet data files plus a JSON manifest log:
       _manifests/v000001.json ...          (one per snapshot)
 
 A snapshot lists the data files that constitute the table at that version.
-Writers stage data files first, then commit by writing the next manifest
-(atomic via os.rename), so readers always see a consistent snapshot.
-Rollback appends a new manifest replaying an older file list — history is
-never rewritten, matching Iceberg's rollback_to_timestamp semantics.
+Writers stage data files first, then commit by publishing the next manifest
+(create-exclusive), so readers always see a consistent snapshot. Rollback
+appends a new manifest replaying an older file list — history is never
+rewritten, matching Iceberg's rollback_to_timestamp semantics.
+
+All IO routes through the fsspec seam (io/fs.py), so a table may live on a
+local path, memory:// (tests), or any cloud URL — the reference reaches
+HDFS/S3/GS in every phase and a multi-host run needs a shared warehouse.
 """
 
 from __future__ import annotations
 
 import json
-import os
+import posixpath
 import time
 import uuid
 
 import pyarrow as pa
 import pyarrow.dataset as pads
 import pyarrow.parquet as pq
+
+from ..io.fs import get_fs, put_if_absent
 
 _MANIFEST_DIR = "_manifests"
 _DATA_DIR = "data"
@@ -38,10 +44,11 @@ class LakehouseError(Exception):
 
 class LakehouseTable:
     def __init__(self, path: str):
-        self.path = path
-        self.manifest_dir = os.path.join(path, _MANIFEST_DIR)
-        self.data_dir = os.path.join(path, _DATA_DIR)
-        if not os.path.isdir(self.manifest_dir):
+        self.path = str(path)
+        self.fs, self.root = get_fs(path)
+        self.manifest_dir = posixpath.join(self.root, _MANIFEST_DIR)
+        self.data_dir = posixpath.join(self.root, _DATA_DIR)
+        if not self.fs.isdir(self.manifest_dir):
             raise LakehouseError(f"{path} is not a lakehouse table")
 
     # -- creation ----------------------------------------------------------
@@ -49,34 +56,41 @@ class LakehouseTable:
     def create(cls, path: str, batches=None, schema: pa.Schema | None = None):
         """Create an empty table (or one seeded from an iterable of record
         batches / a pa.Table)."""
-        os.makedirs(os.path.join(path, _MANIFEST_DIR), exist_ok=True)
-        os.makedirs(os.path.join(path, _DATA_DIR), exist_ok=True)
+        fs, root = get_fs(path)
+        fs.makedirs(posixpath.join(root, _MANIFEST_DIR), exist_ok=True)
+        fs.makedirs(posixpath.join(root, _DATA_DIR), exist_ok=True)
         t = cls(path)
         staged = t._stage(batches, schema) if batches is not None else []
         if schema is None and staged:
-            schema = pq.read_schema(os.path.join(path, staged[0][0]))
+            with t.fs.open(posixpath.join(t.root, staged[0][0]), "rb") as fh:
+                schema = pq.read_schema(fh)
         t._commit(staged, "create", base_files=[], schema=schema)
         return t
 
     @classmethod
     def is_table(cls, path: str) -> bool:
-        return os.path.isdir(os.path.join(path, _MANIFEST_DIR))
+        fs, root = get_fs(path)
+        return fs.isdir(posixpath.join(root, _MANIFEST_DIR))
 
     # -- snapshot log ------------------------------------------------------
     def versions(self):
         """[(version, timestamp_ms, operation)] ascending."""
         out = []
-        for f in sorted(os.listdir(self.manifest_dir)):
-            if f.startswith("v") and f.endswith(".json"):
-                with open(os.path.join(self.manifest_dir, f)) as fh:
+        for f in sorted(self.fs.ls(self.manifest_dir, detail=False)):
+            name = posixpath.basename(f)
+            if name.startswith("v") and name.endswith(".json"):
+                with self.fs.open(f, "r") as fh:
                     m = json.load(fh)
                 out.append((m["version"], m["timestamp_ms"], m["operation"]))
         return out
 
     def _manifest(self, version: int) -> dict:
-        p = os.path.join(self.manifest_dir, f"v{version:06d}.json")
-        with open(p) as fh:
-            return json.load(fh)
+        p = posixpath.join(self.manifest_dir, f"v{version:06d}.json")
+        try:
+            with self.fs.open(p, "r") as fh:
+                return json.load(fh)
+        except FileNotFoundError:
+            raise LakehouseError(f"{self.path}: no snapshot v{version}")
 
     def current_version(self) -> int:
         vs = [v for v, _, _ in self.versions()]
@@ -86,7 +100,7 @@ class LakehouseTable:
 
     def current_files(self):
         m = self._manifest(self.current_version())
-        return [os.path.join(self.path, f) for f in m["files"]]
+        return [posixpath.join(self.root, f) for f in m["files"]]
 
     def num_rows(self) -> int:
         m = self._manifest(self.current_version())
@@ -101,12 +115,13 @@ class LakehouseTable:
             if schema is None:
                 raise LakehouseError(f"{self.path}: empty table with no schema")
             return pads.dataset(schema.empty_table())
-        return pads.dataset(files, format="parquet")
+        return pads.dataset(files, format="parquet", filesystem=self.fs)
 
     def schema(self) -> pa.Schema | None:
         files = self.current_files()
         if files:
-            return pq.read_schema(files[0])
+            with self.fs.open(files[0], "rb") as fh:
+                return pq.read_schema(fh)
         m = self._manifest(self.current_version())
         if m.get("schema_hex"):
             # an all-rows DELETE leaves zero data files; the manifest still
@@ -125,24 +140,28 @@ class LakehouseTable:
             batches = batches.to_batches(max_chunksize=1 << 20)
         staged = []
         writer = None
+        out = None
         relpath = None
         n_rows = 0
         try:
             for b in batches:
                 if writer is None:
-                    relpath = os.path.join(
+                    relpath = posixpath.join(
                         _DATA_DIR, f"part-{uuid.uuid4().hex[:12]}.parquet"
                     )
+                    out = self.fs.open(
+                        posixpath.join(self.root, relpath), "wb"
+                    )
                     writer = pq.ParquetWriter(
-                        os.path.join(self.path, relpath),
-                        schema or b.schema,
-                        compression="snappy",
+                        out, schema or b.schema, compression="snappy"
                     )
                 writer.write_batch(b)
                 n_rows += b.num_rows
         finally:
             if writer is not None:
                 writer.close()
+            if out is not None:
+                out.close()
         if relpath is not None:
             staged.append((relpath, n_rows))
         return staged
@@ -178,23 +197,20 @@ class LakehouseTable:
             "num_rows": total,
             "schema_hex": schema_hex,
         }
-        tmp = os.path.join(self.manifest_dir, f".tmp-{uuid.uuid4().hex}.json")
-        with open(tmp, "w") as fh:
+        tmp = posixpath.join(self.manifest_dir, f".tmp-{uuid.uuid4().hex}.json")
+        with self.fs.open(tmp, "w") as fh:
             json.dump(manifest, fh)
-        # optimistic concurrency: os.link refuses to clobber an existing
-        # manifest, so a concurrent writer that claimed the same version
-        # fails loudly instead of silently last-writer-winning (Iceberg's
-        # commit-conflict guarantee)
-        dest = os.path.join(self.manifest_dir, f"v{version:06d}.json")
-        try:
-            os.link(tmp, dest)
-        except FileExistsError:
-            os.unlink(tmp)
+        # optimistic concurrency: publish is create-exclusive, so a
+        # concurrent writer that claimed the same version fails loudly
+        # instead of silently last-writer-winning (Iceberg's
+        # commit-conflict guarantee; see io/fs.py put_if_absent for the
+        # local-atomic vs remote-best-effort split)
+        dest = posixpath.join(self.manifest_dir, f"v{version:06d}.json")
+        if not put_if_absent(self.fs, tmp, dest):
             raise LakehouseError(
                 f"{self.path}: concurrent commit conflict at version "
                 f"{version}; retry the transaction"
             )
-        os.unlink(tmp)
         return version
 
     def append(self, table, operation="append") -> int:

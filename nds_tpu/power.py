@@ -15,6 +15,7 @@ import time
 from collections import OrderedDict
 
 from .check import check_json_summary_folder, check_query_subset_exists
+from .io.fs import fs_open
 from .datagen.query_streams import split_special_query
 from .engine.session import Session
 from .report import BenchReport
@@ -25,7 +26,7 @@ def gen_sql_from_stream(query_stream_file_path: str) -> "OrderedDict[str, str]":
     """Split a generated stream file into {query_name: sql} on the
     `-- start query N in stream S using template queryK.tpl` markers.
     Two-statement entries (templates 14/23/24/39) become `_part1`/`_part2`."""
-    with open(query_stream_file_path) as f:
+    with fs_open(query_stream_file_path) as f:
         stream = f.read()
     queries = OrderedDict()
     for q in stream.split("-- start")[1:]:
@@ -113,7 +114,7 @@ def run_one_query(session, query, query_name, output_path, output_format):
 
 def load_properties(filename: str) -> dict:
     props = {}
-    with open(filename) as f:
+    with fs_open(filename) as f:
         for line in f:
             line = line.strip()
             if not line or line.startswith("#"):
@@ -211,14 +212,14 @@ def run_query_stream(
     for row in execution_time_list:
         print(row)
     if time_log_output_path:
-        with open(time_log_output_path, "w", encoding="UTF8", newline="") as f:
+        with fs_open(time_log_output_path, "w", encoding="UTF8", newline="") as f:
             writer = csv.writer(f)
             writer.writerow(header)
             writer.writerows(execution_time_list)
     if extra_time_log_output_path:
         # reference writes this via Spark so it can land on cloud storage;
         # our IO layer is fs-agnostic, a plain copy keeps the contract
-        with open(extra_time_log_output_path, "w", encoding="UTF8", newline="") as f:
+        with fs_open(extra_time_log_output_path, "w", encoding="UTF8", newline="") as f:
             writer = csv.writer(f)
             writer.writerow(header)
             writer.writerows(execution_time_list)

@@ -22,6 +22,8 @@ from typing import Callable
 
 import jax
 
+from .io.fs import fs_open
+
 from . import __version__
 
 _REDACTED = ("TOKEN", "SECRET", "PASSWORD", "PASSWD", "CREDENTIAL", "KEY")
@@ -132,6 +134,6 @@ class BenchReport:
         self.summary["query"] = query_name
         filename = f"{prefix}-{query_name}-{self.summary['startTime']}.json"
         self.summary["filename"] = filename
-        with open(filename, "w") as f:
+        with fs_open(filename, "w") as f:
             json.dump(self.summary, f, indent=2)
         return filename
