@@ -445,6 +445,12 @@ def unify_dictionaries(a: Column, b: Column):
     (codes_a, codes_b, unified_dictionary); the remap is O(|dict|) on host +
     O(n) gathers on device.
     """
+    if a.dictionary is not None and a.dictionary is b.dictionary:
+        # already share one dictionary (common after unions/CTE reuse over
+        # the same base column): codes are directly comparable — skip the
+        # host-side unique/index_in work, which costs real milliseconds
+        # per join on 100k-entry dictionaries
+        return a.data, b.data, a.dictionary
     da = a.dictionary if a.dictionary is not None else pa.array([], type=pa.string())
     db = b.dictionary if b.dictionary is not None else pa.array([], type=pa.string())
     unified = pc.unique(pa.concat_arrays([da.cast(pa.string()), db.cast(pa.string())]))

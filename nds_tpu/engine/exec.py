@@ -71,6 +71,59 @@ def _resolve_bounds(datas, valids, stats_list, wanted, live):
     return bounds
 
 
+def _cascade_agg_items(agg_items):
+    """Re-aggregation exprs for the rollup cascade, or None when any
+    aggregate doesn't decompose over partial results. sum/min/max compose
+    with themselves; any count becomes a sum of the level below's counts."""
+    out = []
+    for a, name in agg_items:
+        if a.distinct or a.fn not in ("sum", "min", "max", "count"):
+            return None
+        fn = "sum" if a.fn == "count" else a.fn
+        out.append((E.Agg(fn, E.Col(name)), name))
+    return out
+
+
+def _rollup_base_aggs(agg_items):
+    """(base agg list, avg items) for grouping-sets execution: every plain
+    avg is decomposed into hidden sum (__cs_<name>) + count (__cc_<name>)
+    columns so the cascade can compose it; the visible avg column is
+    derived per part by _derive_rollup_avgs with the exact semantics of
+    the direct avg path (float64, decimal descale, NULL on empty).
+    Returns (None, []) when any aggregate rules the rewrite out."""
+    if not all(
+        not a.distinct and a.fn in ("sum", "min", "max", "count", "avg")
+        for a, _ in agg_items
+    ):
+        return None, []
+    avg_items = [(a, n) for a, n in agg_items if a.fn == "avg"]
+    if not avg_items:
+        return list(agg_items), []
+    base = []
+    for a, name in agg_items:
+        if a.fn == "avg":
+            base.append((E.Agg("sum", a.arg), f"__cs_{name}"))
+            base.append((E.Agg("count", a.arg), f"__cc_{name}"))
+        else:
+            base.append((a, name))
+    return base, avg_items
+
+
+def _derive_rollup_avgs(part: "Table", avg_items):
+    if not avg_items:
+        return part
+    cols = dict(part.columns)
+    for _, name in avg_items:
+        cs = cols[f"__cs_{name}"]
+        cc = cols[f"__cc_{name}"]
+        n = cc.data
+        val = cs.data.astype(jnp.float64) / jnp.maximum(n, 1)
+        if cs.dtype.is_decimal:
+            val = val / 10**cs.dtype.scale
+        cols[name] = Column(val, FLOAT64, n > 0)
+    return Table(cols, part.nrows_lazy, live=part.live)
+
+
 def _plain_col_names(exprs, table):
     """Column names referenced by plain Col exprs, resolved the way the
     evaluator resolves them against `table` (qualified first, bare next)."""
@@ -1146,12 +1199,48 @@ class Executor:
         # a hard device OOM is UNRECOVERABLE on this backend (the axon
         # terminal stays poisoned even after every buffer is freed and the
         # client is re-created), so peak memory is a correctness concern.
+        #
+        # Cascade: when every aggregate decomposes (sum/min/max/count) and
+        # the sets chain by inclusion (ROLLUP prefixes do), each coarser
+        # level re-aggregates the PREVIOUS level's output — one pass over
+        # the fact-scale input instead of one per set (q67: nine 8.8M-row
+        # passes became one + eight over <=2M group rows).
+        base_aggs, avg_items = _rollup_base_aggs(node.aggs)
+        casc_aggs = _cascade_agg_items(base_aggs) if base_aggs else None
         out = None
-        for s in node.grouping_sets:
-            part = self._aggregate_once(
-                node.keys, node.aggs, s, child, live, nlive
-            )
+        prev = None
+        prev_set = None
+        sets = sorted(node.grouping_sets, key=len, reverse=True)
+        for s in sets:
+            if (
+                prev is not None
+                and casc_aggs is not None
+                and set(s) <= set(prev_set)
+            ):
+                key_items2 = [
+                    (E.Col(name), name) for (_, name) in node.keys
+                ]
+                part = self._aggregate_once(
+                    key_items2, casc_aggs, s, prev, prev.row_mask(),
+                    prev.nrows_known,
+                )
+            else:
+                part = self._aggregate_once(
+                    node.keys, base_aggs or node.aggs, s, child, live, nlive
+                )
+            part = _derive_rollup_avgs(part, avg_items)
+            prev, prev_set = part, s
             out = part if out is None else self._concat(out, part)
+        if avg_items:
+            out = Table(
+                {
+                    n: c
+                    for n, c in out.columns.items()
+                    if not n.startswith("__cs_") and not n.startswith("__cc_")
+                },
+                out.nrows_lazy,
+                live=out.live,
+            )
         return out.compacted()
 
     def _agg_input(self, node: P.Aggregate):

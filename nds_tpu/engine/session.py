@@ -283,13 +283,18 @@ class Catalog:
         pk = TABLE_PRIMARY_KEYS.get(name)
         if pk is not None and all(c in columns for c in pk):
             if e.pk_verified is None:
-                if len(pk) == 1:
+                st = (
+                    out.columns[pk[0]].stats if len(pk) == 1 else None
+                )
+                if st is not None and st.unique:
                     # single-column PK: ingest-time host stats already
                     # know distinctness — zero device work
-                    st = out.columns[pk[0]].stats
-                    e.pk_verified = bool(st is not None and st.unique)
+                    e.pk_verified = True
                 else:
-                    # composite PK (the 7 fact tables): one-time device
+                    # composite PK (the 7 fact tables), or a single-column
+                    # PK whose ingest stats didn't establish uniqueness
+                    # (stats skip count_distinct above a row threshold —
+                    # unique=False there means UNKNOWN): one-time device
                     # sort + sync, memoized until DML invalidates
                     e.pk_verified = _pk_holds(out, pk)
             if e.pk_verified:
@@ -492,14 +497,15 @@ class Session:
             if maintenance
             else get_schemas(self.use_decimal)
         )
+        import posixpath
+
         from ..io.fs import get_fs, join as fs_join
 
-        fs, _ = get_fs(data_root)
+        fs, root = get_fs(data_root)
         for tname, schema in schemas.items():
-            path = fs_join(data_root, tname)
-            if fs.exists(get_fs(path)[1]):
+            if fs.exists(posixpath.join(root, tname)):
                 self.catalog.entries[tname] = _Entry(
-                    schema=schema, path=path, fmt=fmt
+                    schema=schema, path=fs_join(data_root, tname), fmt=fmt
                 )
 
     def drop(self, name):

@@ -279,9 +279,14 @@ def _run_call(session, stmt: A.CallStmt):
 def _to_ts_ms(ts) -> int:
     if isinstance(ts, str):
         try:
-            ts = float(ts)  # CLI args arrive as strings
+            v = float(ts)  # CLI args arrive as strings
         except ValueError:
-            pass
+            v = None
+        # only plausible epoch magnitudes (>= ~2001 in seconds): a
+        # dash-less date like '20240101' must fall through to the date
+        # parser and error loudly, not roll back to 1970
+        if v is not None and v >= 10**9:
+            ts = v
     if isinstance(ts, (int, float)):
         # numeric: epoch seconds (fractional ok) or ms if large
         return int(ts if ts > 10**12 else ts * 1000)

@@ -261,10 +261,11 @@ def _write_hive_partitioned_parquet(
             if buf_bytes[dirname] >= FLUSH_BYTES:
                 flush(dirname)
         if total_buffered >= GLOBAL_BYTES:
-            # flush the largest half to stay within the host-memory bound
-            for d in sorted(buf_bytes, key=buf_bytes.get, reverse=True)[
-                : max(1, len(buf_bytes) // 2)
-            ]:
+            # flush EVERYTHING: buffered parts are zero-copy slices that
+            # pin their whole source chunk, so partial flushes would free
+            # accounting but not RSS — only releasing every reference to
+            # the chunks actually bounds host memory
+            for d in list(buffers):
                 flush(d)
     for d in list(buffers):
         flush(d)

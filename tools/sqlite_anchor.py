@@ -48,16 +48,18 @@ def load(conn):
         conn.execute(
             f"create table {t} ({', '.join(f.name for f in schema)})"
         )
-        cols = arrow.to_pylist()
-        rows = [
-            tuple(
-                v.isoformat() if isinstance(v, (datetime.date,)) else v
-                for v in r.values()
-            )
-            for r in cols
-        ]
         ph = ",".join("?" * len(schema))
-        conn.executemany(f"insert into {t} values ({ph})", rows)
+        # stream per record batch: to_pylist() of a whole SF1 fact table
+        # would box tens of millions of Python values at once
+        for batch in arrow.to_batches(max_chunksize=1 << 17):
+            rows = (
+                tuple(
+                    v.isoformat() if isinstance(v, (datetime.date,)) else v
+                    for v in r.values()
+                )
+                for r in batch.to_pylist()
+            )
+            conn.executemany(f"insert into {t} values ({ph})", rows)
         print(f"loaded {t}: {arrow.num_rows} rows", flush=True)
         # index every surrogate-key column: sqlite's nested-loop joins need
         # them; this is the fair (favorable-to-sqlite) configuration
