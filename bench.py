@@ -32,6 +32,15 @@ NDS scale factor 10 (wall-budgeted, fail-soft), and `sqlite_anchor` embeds
 the external sqlite baseline over the identical SF1 stream (computed
 offline by tools/sqlite_anchor.py into anchors/sqlite_sf1.json).
 
+Measured SF10 state (2026-07-31): transcode ~222k rows/s and the first
+four queries complete (q3 steady 2.6s — 2.4x its SF1 time for 10x data);
+query5's three-channel union (64M-row concat capacity x ~10 columns) is
+the single-chip HBM ceiling — it hard-OOMs the device, which poisons this
+backend irrecoverably, so the loop bails after 3 consecutive OOMs. The
+morsel plan to break it: blocked union-aggregation (concat and aggregate
+channel CTEs in bounded row windows, like the rollup cascade bounds
+grouping-set concats).
+
 Env knobs: NDS_BENCH_SCALE (default 1), NDS_BENCH_DATA,
 NDS_BENCH_SKIP_GEOMEAN, NDS_BENCH_SKIP_TRANSCODE, NDS_BENCH_SKIP_SF10,
 NDS_BENCH_SF10_BUDGET (s), NDS_BENCH_QUERY_TIMEOUT,
@@ -183,6 +192,7 @@ def bench_geomean(sess, block=None, scale=None, wall_budget=None):
                   f"(names look like 'query3')", file=sys.stderr)
     detail = {}      # name -> {"cold": s, "steady": s}; steady feeds geomean
     failed = {}      # name -> error text (artifact evidence)
+    consecutive_oom = 0  # poisoned-backend detector (see break below)
 
     # daemon-thread timeout: a wedged device runtime blocks inside native
     # code where signals never fire; joining a daemon thread with a timeout
@@ -293,6 +303,7 @@ def bench_geomean(sess, block=None, scale=None, wall_budget=None):
                     file=sys.stderr,
                 )
                 update_out()
+                consecutive_oom = 0
                 continue
             failed[name] = f"timeout (> {per_query_budget}s, {status})"
             detail.pop(name, None)
@@ -308,6 +319,21 @@ def bench_geomean(sess, block=None, scale=None, wall_budget=None):
             print(f"[{i + 1}/{len(queries)}] {name}: FAILED {exc}",
                   file=sys.stderr)
             update_out()
+            if "RESOURCE_EXHAUSTED" in failed[name]:
+                # a hard device OOM permanently poisons this backend (the
+                # axon terminal stays wedged even after recover_memory);
+                # three in a row means every further query would burn the
+                # run budget failing the same way
+                consecutive_oom += 1
+                if consecutive_oom >= 3:
+                    block["aborted"] = (
+                        "backend poisoned by device OOM; remaining "
+                        "queries skipped"
+                    )
+                    emit()
+                    break
+            else:
+                consecutive_oom = 0
 
 
 def load_sqlite_anchor():
@@ -395,6 +421,10 @@ def bench_sf10(sess_sf1):
     # free the SF1 session's device residency before loading SF10 tables
     sess_sf1.recover_memory("switching to SF10 data")
     sess = Session()
+    # SF10 fact caps are 32M rows: a single multi-column pair table is
+    # GB-scale, and one hard OOM poisons the backend for the whole rest of
+    # the stream (axon terminal). Trade table-reload time for headroom.
+    sess.catalog.DEVICE_BUDGET_BYTES = 3 << 30
     schemas = get_schemas()
     for t, schema in schemas.items():
         path = os.path.join(data_dir, t)

@@ -282,10 +282,11 @@ def _to_ts_ms(ts) -> int:
             v = float(ts)  # CLI args arrive as strings
         except ValueError:
             v = None
-        # only plausible epoch magnitudes (>= ~2001 in seconds): a
-        # dash-less date like '20240101' must fall through to the date
-        # parser and error loudly, not roll back to 1970
-        if v is not None and v >= 10**9:
+        # only plausible epoch magnitudes (~2001..2286 in seconds or ms): a
+        # dash-less date like '20240101' or '20240101120000' must fall
+        # through to the date parser and error loudly, not be taken as an
+        # epoch in 1970 or 2611
+        if v is not None and 10**9 <= v < 10**13:
             ts = v
     if isinstance(ts, (int, float)):
         # numeric: epoch seconds (fractional ok) or ms if large
