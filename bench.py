@@ -32,19 +32,29 @@ NDS scale factor 10 (wall-budgeted, fail-soft), and `sqlite_anchor` embeds
 the external sqlite baseline over the identical SF1 stream (computed
 offline by tools/sqlite_anchor.py into anchors/sqlite_sf1.json).
 
-Measured SF10 state (2026-07-31): transcode ~222k rows/s and the first
-four queries complete (q3 steady 2.6s — 2.4x its SF1 time for 10x data);
-query5's three-channel union (64M-row concat capacity x ~10 columns) is
-the single-chip HBM ceiling — it hard-OOMs the device, which poisons this
-backend irrecoverably, so the loop bails after 3 consecutive OOMs. The
-morsel plan to break it: blocked union-aggregation (concat and aggregate
-channel CTEs in bounded row windows, like the rollup cascade bounds
-grouping-set concats).
+Measured SF10 state (2026-07-31, pre-blocked-path): transcode ~222k rows/s
+and the first four queries complete (q3 steady 2.6s — 2.4x its SF1 time
+for 10x data); query5's three-channel union (64M-row concat capacity x
+~10 columns) was the single-chip HBM ceiling — it hard-OOMed the device,
+poisoning the backend irrecoverably, so the loop bailed after 3
+consecutive OOMs and skipped queries 5-99. The engine now routes
+union-feeding-aggregate plans (through projections/filters AND inner
+joins — the query5 channel shape) into blocked (morsel-style)
+union-aggregation (engine/exec.py:_blocked_union_ctx): each union branch
+is evaluated, joined and partially aggregated in bounded row windows
+sized from the session HBM budget, so the full concat never materializes
+and queries past query5 now record times or per-query errors instead of
+an "aborted" marker. The consecutive-OOM bail now only counts OOMs from
+queries that did NOT route through the blocked path (those can still
+poison the backend).
 
 Env knobs: NDS_BENCH_SCALE (default 1), NDS_BENCH_DATA,
-NDS_BENCH_SKIP_GEOMEAN, NDS_BENCH_SKIP_TRANSCODE, NDS_BENCH_SKIP_SF10,
-NDS_BENCH_SF10_BUDGET (s), NDS_BENCH_QUERY_TIMEOUT,
-NDS_BENCH_QUERY_SUBSET (comma-separated query names, debug aid).
+NDS_BENCH_DATA_SF10 (default: NDS_BENCH_DATA + "_sf10.0", else
+/tmp/nds_bench_sf10.0), NDS_BENCH_SKIP_GEOMEAN, NDS_BENCH_SKIP_TRANSCODE,
+NDS_BENCH_SKIP_SF10, NDS_BENCH_SF10_BUDGET (s), NDS_BENCH_QUERY_TIMEOUT,
+NDS_BENCH_QUERY_SUBSET (comma-separated query names, debug aid), and the
+engine's NDS_UNION_AGG_WINDOW_ROWS (blocked union-aggregation window size;
+default derived from the catalog device budget).
 """
 
 import json
@@ -192,14 +202,14 @@ def bench_geomean(sess, block=None, scale=None, wall_budget=None):
                   f"(names look like 'query3')", file=sys.stderr)
     detail = {}      # name -> {"cold": s, "steady": s}; steady feeds geomean
     failed = {}      # name -> error text (artifact evidence)
-    consecutive_oom = 0  # poisoned-backend detector (see break below)
+    consecutive_oom = 0  # poisoned-backend detector for UNBLOCKED queries
 
     # daemon-thread timeout: a wedged device runtime blocks inside native
     # code where signals never fire; joining a daemon thread with a timeout
     # still returns control, and daemon threads don't block process exit
     per_query_budget = int(os.environ.get("NDS_BENCH_QUERY_TIMEOUT", "900"))
 
-    def run_with_timeout(q, budget):
+    def run_with_timeout(q, budget, meta=None):
         import threading
 
         box = {}
@@ -209,13 +219,29 @@ def bench_geomean(sess, block=None, scale=None, wall_budget=None):
                 # error as TEXT, never a live exception: a held traceback
                 # would pin the failed attempt's device intermediates
                 # through the recovery
+                r = None
                 try:
                     r = sess.run_script(q)
                     if r is not None:
                         r.collect()
-                    return None
+                    err = None
                 except Exception as exc:
-                    return str(exc) or type(exc).__name__
+                    err = str(exc) or type(exc).__name__
+                # blocked union-agg marker, read in the query's OWN thread:
+                # the Result's executor is per-query (race-free even when a
+                # previous query's wedged thread is still running); the
+                # session-level marker is the fallback for statements that
+                # executed eagerly (CreateTempView) outside this Result.
+                # Attribution is script-scoped: a script where one
+                # statement routed blocked and a DIFFERENT one OOMed
+                # unblocked is still exempted — acceptable slack for a
+                # bail heuristic (the abort just needs more evidence)
+                ex = getattr(r, "executor", None)
+                if getattr(ex, "last_blocked_union", None) is not None or (
+                    getattr(sess, "last_blocked_union", None) is not None
+                ):
+                    box["blocked"] = True
+                return err
 
             err = attempt()
             if err is not None and "RESOURCE_EXHAUSTED" in err:
@@ -234,14 +260,23 @@ def bench_geomean(sess, block=None, scale=None, wall_budget=None):
         th.start()
         th.join(budget)
         finished_late = False
+        wedged = False
         if th.is_alive():
             # grace join: distinguish slow-but-progressing from wedged; a
             # still-stuck worker must not race the next query on the shared
             # session, so a true wedge aborts the whole geomean
             th.join(60)
             if th.is_alive():
-                return "wedged"
-            finished_late = True
+                wedged = True
+            else:
+                finished_late = True
+        # read the blocked marker AFTER the grace join: a slow blocked query
+        # sets box["blocked"] late, and the OOM-bail exemption must still
+        # see it when the exception below is raised
+        if meta is not None and box.get("blocked"):
+            meta["blocked"] = True
+        if wedged:
+            return "wedged"
         if "exc" in box:  # real failures beat the timeout label
             raise box["exc"]
         if "ok" in box:
@@ -278,9 +313,11 @@ def bench_geomean(sess, block=None, scale=None, wall_budget=None):
             block["truncated_after"] = i
             emit()
             break
+        sess.last_blocked_union = None  # set by blocked union-agg execution
+        meta = {}  # run_with_timeout sets meta["blocked"] when it routed
         try:
             t0 = time.perf_counter()
-            status = run_with_timeout(q, per_query_budget)
+            status = run_with_timeout(q, per_query_budget, meta)
             cold = time.perf_counter() - t0
             if status == "ok":
                 # steady-state timing measures true execution: disable the
@@ -290,7 +327,7 @@ def bench_geomean(sess, block=None, scale=None, wall_budget=None):
                 sess.conf["engine.plan_cache"] = "off"
                 try:
                     t0 = time.perf_counter()
-                    status = run_with_timeout(q, per_query_budget)
+                    status = run_with_timeout(q, per_query_budget, meta)
                     detail[name] = {
                         "cold": cold, "steady": time.perf_counter() - t0,
                     }
@@ -320,18 +357,24 @@ def bench_geomean(sess, block=None, scale=None, wall_budget=None):
                   file=sys.stderr)
             update_out()
             if "RESOURCE_EXHAUSTED" in failed[name]:
-                # a hard device OOM permanently poisons this backend (the
-                # axon terminal stays wedged even after recover_memory);
-                # three in a row means every further query would burn the
-                # run budget failing the same way
-                consecutive_oom += 1
-                if consecutive_oom >= 3:
-                    block["aborted"] = (
-                        "backend poisoned by device OOM; remaining "
-                        "queries skipped"
-                    )
-                    emit()
-                    break
+                # Queries that routed through the blocked union-aggregation
+                # path (the SF10 OOM source, query5 and kin) no longer feed
+                # the bail: their OOM is a per-query error worth recording,
+                # not grounds to skip the stream. But a hard OOM on an
+                # UNBLOCKED shape still permanently poisons this backend
+                # (the axon terminal stays wedged even after
+                # recover_memory), so three of those in a row means every
+                # further query would burn the run budget failing the same
+                # way.
+                if not meta.get("blocked"):
+                    consecutive_oom += 1
+                    if consecutive_oom >= 3:
+                        block["aborted"] = (
+                            "backend poisoned by device OOM on unblocked "
+                            "plans; remaining queries skipped"
+                        )
+                        emit()
+                        break
             else:
                 consecutive_oom = 0
 
@@ -403,6 +446,20 @@ def main():
         emit()
 
 
+def _sf10_data_dir() -> str:
+    """SF10 data dir: NDS_BENCH_DATA_SF10 wins outright; else a
+    "_sf10.0"-suffixed sibling of NDS_BENCH_DATA (an operator redirecting
+    SF1 data to a larger volume gets SF10 on the same volume, not ~10 GB
+    silently dumped under /tmp); /tmp only as the last-resort default."""
+    explicit = os.environ.get("NDS_BENCH_DATA_SF10")
+    if explicit:
+        return explicit
+    base = os.environ.get("NDS_BENCH_DATA")
+    if base:
+        return base.rstrip("/") + "_sf10.0"
+    return "/tmp/nds_bench_sf10.0"
+
+
 def bench_sf10(sess_sf1):
     """Secondary block at SF10 (BASELINE ladder: the next rung after SF1;
     store_sales = 28.8M rows — fits HBM, stresses every capacity
@@ -414,7 +471,7 @@ def bench_sf10(sess_sf1):
     from nds_tpu.schema import get_schemas
 
     block = OUT.setdefault("sf10", {})
-    data_dir = "/tmp/nds_bench_sf10.0"
+    data_dir = _sf10_data_dir()
     ensure_data(scale=10, data_dir=data_dir, parallel=8)
     block["transcode_rows_per_sec"] = round(bench_transcode(data_dir))
     emit()

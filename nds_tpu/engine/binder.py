@@ -1167,6 +1167,14 @@ def _null_rejecting_shape(conj):
             "coalesce", "ifnull", "nvl",
         ):
             return False
+        # null-tolerant boolean connectives nested inside an operand:
+        # `a.x = (b.y OR TRUE)` is TRUE even when b.y is NULL, so the
+        # comparison is NOT strict in b's columns (three-valued logic lets
+        # AND/OR absorb a NULL input)
+        if x is not conj and isinstance(x, E.BinOp) and x.op in (
+            "and", "or",
+        ):
+            return False
     return True
 
 

@@ -25,6 +25,7 @@ configures it; the batches themselves live in the external engine).
 from __future__ import annotations
 
 from dataclasses import dataclass, replace
+from functools import partial
 from typing import Optional
 
 import jax
@@ -217,6 +218,44 @@ class Table:
             )
         self._packed = Table(cols, count, unique_key=self.unique_key)
         return self._packed
+
+
+# ---------------------------------------------------------------------------
+# Bounded row windows (blocked union-aggregation)
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("cap",))
+def _dyn_slice(arr: jnp.ndarray, start, cap: int) -> jnp.ndarray:
+    return jax.lax.dynamic_slice_in_dim(arr, start, cap)
+
+
+def window_slice(table: Table, start: int, cap: int) -> Table:
+    """Rows [start, start+cap) of a compacted table as a Table of capacity
+    `cap`, via per-column dynamic slices — never a full-capacity gather.
+
+    `cap` must be a power-of-two bucket <= table.cap and `start` a multiple
+    of `cap`, so the slice can never clamp (both caps are power-of-two
+    buckets, hence table.cap is a multiple of cap). The start index stays a
+    traced scalar, so every window of a given (shape, cap) pair shares one
+    compiled slice kernel."""
+    if table.live is not None:
+        raise ValueError("window_slice requires a compacted table")
+    if cap >= table.cap:
+        return table
+    if start % cap:
+        raise ValueError(f"window start {start} not aligned to cap {cap}")
+    nrows = min(max(table.nrows - start, 0), cap)
+    cols = {}
+    for name, c in table.columns.items():
+        cols[name] = Column(
+            _dyn_slice(c.data, start, cap),
+            c.dtype,
+            None if c.valid is None else _dyn_slice(c.valid, start, cap),
+            c.dictionary,
+            c.subset_stats(),
+        )
+    return Table(cols, nrows, unique_key=table.unique_key)
 
 
 # ---------------------------------------------------------------------------

@@ -30,6 +30,7 @@ from .columnar import (
     table_to_arrow,
     unify_dictionaries,
     sort_dictionary,
+    window_slice,
 )
 from .expr import Evaluator, _and_valid, _cast_column
 
@@ -184,6 +185,8 @@ class Executor:
         self._cte_cache = {}  # id(plan) -> Table
         self._scalar_cache = {}  # id(plan) -> python value
         self._fp_cache = {}  # id(plan) -> structural fingerprint
+        # stats of the most recent blocked union-aggregation (tests/tools)
+        self.last_blocked_union = None
 
     # plan-node types worth caching across statements: the expensive
     # pipeline breakers (a CTE body virtually always ends in one)
@@ -261,11 +264,13 @@ class Executor:
         return node.table
 
     def _exec_project(self, node: P.Project) -> Table:
-        child = self.execute(node.child)
+        return self._project_table(self.execute(node.child), node.items)
+
+    def _project_table(self, child: Table, items) -> Table:
         ev = self._evaluator(child)
         cols = {}
         renames = {}  # child column name -> output name (plain Col items)
-        for e, name in node.items:
+        for e, name in items:
             cols[name] = ev.eval(e)
             if isinstance(e, E.Col):
                 # mirror Evaluator._eval_col resolution order
@@ -578,11 +583,22 @@ class Executor:
 
     def _exec_multijoin(self, node: P.MultiJoin) -> Table:
         tables = self._execute_relations_batched(node.relations)
+        return self._multijoin_over_tables(tables, node.edges)
+
+    def _multijoin_over_tables(self, tables, edges, trace=None) -> Table:
+        """Greedy N-way inner join over already-executed relation tables
+        (shared by _exec_multijoin and the blocked union-aggregation path,
+        which re-joins each union window against the other relations).
+        `trace`: optional dict; the first call records its join-order
+        decisions into it and later calls replay them, skipping the greedy
+        cost scan — whose current[g].nrows reads are blocking device->host
+        syncs (~90 ms each on the bench tunnel) that would otherwise run
+        once per window per join step."""
         n = len(tables)
         if n == 1:
             return tables[0]
         # adjacency: edge list by relation index
-        edges = list(node.edges)
+        edges = list(edges)
         merged = list(range(n))  # union-find-ish: relation -> group id
 
         def group(i):
@@ -592,7 +608,7 @@ class Executor:
 
         current = {i: tables[i] for i in range(n)}
 
-        return self._multijoin_greedy(node, tables, current, edges, merged, group, n)
+        return self._multijoin_greedy(current, edges, merged, group, n, trace)
 
     def _execute_relations_batched(self, relations):
         """Execute a MultiJoin's relations and materialize their live
@@ -609,32 +625,46 @@ class Executor:
                 t._nrows = int(v)
         return tables
 
-    def _multijoin_greedy(self, node, tables, current, edges, merged, group, n):
+    def _multijoin_greedy(self, current, edges, merged, group, n, trace=None):
         # greedy: repeatedly take the connecting edge whose joined inputs are
-        # smallest (sum of live rows), execute that join
+        # smallest (sum of live rows), execute that join. When `trace`
+        # carries recorded steps, replay them instead (identical relation
+        # sets join in the same order, and replay never reads .nrows — the
+        # blocked union path joins every window with zero count syncs).
+        replay = trace is not None and "steps" in trace
+        steps = trace["steps"] if replay else []
+        step_i = 0
         while True:
             groups = {group(i) for i in range(n)}
             if len(groups) == 1:
                 break
-            best = None
-            for k, (i, j, le, re_) in enumerate(edges):
-                gi, gj = group(i), group(j)
-                if gi == gj:
-                    continue
-                cost = current[gi].nrows + current[gj].nrows
-                if best is None or cost < best[0]:
-                    best = (cost, k, gi, gj)
-            if best is None:
+            if replay:
+                kind, gi, gj = steps[step_i]
+                step_i += 1
+            else:
+                best = None
+                for k, (i, j, le, re_) in enumerate(edges):
+                    gi, gj = group(i), group(j)
+                    if gi == gj:
+                        continue
+                    cost = current[gi].nrows + current[gj].nrows
+                    if best is None or cost < best[0]:
+                        best = (cost, k, gi, gj)
+                if best is None:
+                    kind, gi, gj = "cross", *sorted(
+                        groups, key=lambda g: current[g].nrows
+                    )[:2]
+                else:
+                    kind, gi, gj = "edge", best[2], best[3]
+                steps.append((kind, gi, gj))
+            if kind == "cross":
                 # disconnected components: cross join smallest two groups
-                gs = sorted(groups, key=lambda g: current[g].nrows)
-                gi, gj = gs[0], gs[1]
                 joined = self._join(
                     current[gi], current[gj], "cross", [], [], None
                 )
                 merged[gj] = gi
                 current[gi] = joined
                 continue
-            _, k, gi, gj = best
             # gather ALL edges connecting these two groups as one multi-key join
             lkeys, rkeys = [], []
             rest = []
@@ -652,8 +682,9 @@ class Executor:
             joined = self._join(current[gi], current[gj], "inner", lkeys, rkeys, None)
             merged[gj] = gi
             current[gi] = joined
-        out = current[group(0)]
-        return out
+        if trace is not None and not replay:
+            trace["steps"] = steps
+        return current[group(0)]
 
     # ------------------------------------------------------------------
     def _pack_sparse(self, t: Table) -> Table:
@@ -1188,11 +1219,21 @@ class Executor:
     # ------------------------------------------------------------------
     # ------------------------------------------------------------------
     def _exec_aggregate(self, node: P.Aggregate) -> Table:
-        child, live, nlive = self._agg_input(node)
+        blocked = self._blocked_union_ctx(node) if node.blocked_union else None
         if node.grouping_sets is None:
+            if blocked is not None:
+                return self._finish_blocked_union(node, blocked)
+            child, live, nlive = self._agg_input(node)
             return self._aggregate_once(
                 node.keys, node.aggs, None, child, live, nlive
             )
+        if blocked is not None:
+            # ROLLUP over a union (the query5 shape): from-scratch levels
+            # run windowed; cascade levels re-aggregate small group tables
+            # as usual — the full union concat never materializes
+            child = live = nlive = None
+        else:
+            child, live, nlive = self._agg_input(node)
         # ROLLUP: concat incrementally and never retain the per-set parts
         # (q67's nine sets at fact-scale group caps held several GB), then
         # pack the masked concat chain before downstream windows/sorts —
@@ -1224,6 +1265,8 @@ class Executor:
                     key_items2, casc_aggs, s, prev, prev.row_mask(),
                     prev.nrows_known,
                 )
+            elif blocked is not None:
+                part = self._blocked_union_once(node, blocked, s)
             else:
                 part = self._aggregate_once(
                     node.keys, base_aggs or node.aggs, s, child, live, nlive
@@ -1241,7 +1284,264 @@ class Executor:
                 out.nrows_lazy,
                 live=out.live,
             )
+        if blocked is not None:
+            self._annotate_blocked(node, blocked)
         return out.compacted()
+
+    # -- blocked (morsel-style) union-aggregation -------------------------
+    # A union_all feeding an aggregate (directly, through Project/Filter
+    # wrappers, or as one relation of an inner MultiJoin) never
+    # materializes the full concat: each branch is evaluated in bounded row
+    # windows, every window is (joined against the other relations, then)
+    # partially aggregated with the rollup cascade's decomposable-aggregate
+    # machinery (sum/min/max/count, avg via hidden sum+count), and partials
+    # merge incrementally — peak live HBM is O(window + group rows) instead
+    # of O(total union rows). This is what breaks the SF10 single-chip
+    # ceiling: query5's per-channel sales+returns union is a fact-scale
+    # concat (~32M rows x ~6 columns per channel at SF10) joined to
+    # date_dim/store before aggregation — it hard-OOMs (and irrecoverably
+    # poisons) the device on the unblocked path (bench.py).
+
+    def _blocked_union_ctx(self, node: P.Aggregate):
+        """Prepare windowed execution of a blocked-union aggregate: execute
+        + align the union branches, execute the non-union join relations
+        once, and size the window. Returns a context dict, or None when the
+        shape/aggregates/size rule the blocked path out (callers fall
+        through to the unblocked path)."""
+        shape = P.union_agg_shape(node)
+        if shape is None:
+            return None
+        session = getattr(self.catalog, "session", None)
+        if session is None:
+            return None  # no budget tracking: stay on the unblocked path
+        base_aggs, avg_items = _rollup_base_aggs(node.aggs)
+        if base_aggs is None:
+            return None  # non-decomposable aggregate (distinct, stddev...)
+        casc_aggs = _cascade_agg_items(base_aggs)
+        if casc_aggs is None:
+            return None
+        outer, join, inner, branch_plans = shape
+        branches = self._execute_relations_batched(branch_plans)
+        total_rows = sum(t.nrows for t in branches)
+        row_bytes = max(
+            sum(
+                int(c.data.dtype.itemsize) + 1  # data + validity byte
+                for c in branches[0].columns.values()
+            ),
+            1,
+        )
+        wrows = session.union_agg_window_rows(row_bytes)
+        if total_rows <= wrows:
+            # single window: the unblocked path is equivalent. Cheap bail —
+            # the branch tables just executed are id-cached in _cte_cache,
+            # so the fall-through SetOp execution reuses them directly.
+            return None
+        join_ctx = None
+        if join is not None:
+            mj, uidx = join
+            # the dimension-side relations execute ONCE and are reused by
+            # every window's join
+            others = self._execute_relations_batched(
+                [r for i, r in enumerate(mj.relations) if i != uidx]
+            )
+            it = iter(others)
+            tables = [
+                None if i == uidx else next(it)
+                for i in range(len(mj.relations))
+            ]
+            join_ctx = (mj.edges, uidx, tables)
+        branches = [t.compacted() for t in branches]
+        aligners = self._union_branch_aligners(branches)
+        # mark the blocked path as ENTERED before any window executes: an
+        # OOM raised mid-window must still be attributable to a blocked
+        # plan (bench.py's poisoned-backend bail exempts those), so the
+        # marker cannot wait for successful completion in _annotate_blocked
+        self.last_blocked_union = {
+            "windows": 0,
+            "window_rows": wrows,
+            "window_cap": bucket_cap(wrows),
+            "total_rows": total_rows,
+            "max_table_cap": 0,
+        }
+        session.last_blocked_union = self.last_blocked_union
+        return {
+            "outer_wrappers": outer,
+            "join": join_ctx,
+            "join_trace": {},  # first window records the order, rest replay
+            "inner_wrappers": inner,
+            "branches": branches,
+            "aligners": aligners,
+            "base_aggs": base_aggs,
+            "avg_items": avg_items,
+            "casc_aggs": casc_aggs,
+            "window_rows": wrows,
+            "window_cap": bucket_cap(wrows),
+            "total_rows": total_rows,
+            "windows": 0,  # accumulated across aggregation levels
+            "max_table_cap": 0,
+        }
+
+    def _apply_wrappers(self, t: Table, wrappers) -> Table:
+        for w in reversed(wrappers):  # innermost wrapper first
+            if isinstance(w, P.Filter):
+                t = self._masked(t, self._predicate_mask(t, w.predicate))
+            else:
+                t = self._project_table(t, w.items)
+        return t
+
+    def _blocked_union_once(self, node: P.Aggregate, ctx, subset):
+        """One aggregation level (grouping-set `subset`, or None for the
+        plain shape) over the union input, evaluated window by window with
+        incremental partial merging. Returns the same table an unblocked
+        _aggregate_once would (hidden avg sum/count columns included)."""
+        wcap = ctx["window_cap"]
+        key_merge = [(E.Col(name), name) for _, name in node.keys]
+        merged = None
+        empty_partial = None
+        for b, aligner in zip(ctx["branches"], ctx["aligners"]):
+            for start in range(0, b.nrows, wcap):
+                w = window_slice(b, start, wcap)
+                ctx["windows"] += 1
+                ctx["max_table_cap"] = max(ctx["max_table_cap"], w.cap)
+                # branch-to-union alignment (rename/cast/dictionary remap)
+                # applies per window: only O(window) aligned copies live
+                wcols = list(w.columns.values())
+                t = Table(
+                    {
+                        name: fn(wcols[ci])
+                        for ci, (name, fn) in enumerate(aligner)
+                    },
+                    w.nrows_lazy,
+                    live=w.live,
+                )
+                t = self._apply_wrappers(t, ctx["inner_wrappers"])
+                if ctx["join"] is not None:
+                    edges, uidx, others = ctx["join"]
+                    t = self._multijoin_over_tables(
+                        [t if i == uidx else o for i, o in enumerate(others)],
+                        edges,
+                        trace=ctx["join_trace"],
+                    )
+                    ctx["max_table_cap"] = max(ctx["max_table_cap"], t.cap)
+                t = self._apply_wrappers(t, ctx["outer_wrappers"])
+                part = self._aggregate_once(
+                    node.keys, ctx["base_aggs"], subset, t, t.row_mask(),
+                    t.nrows_known,
+                )
+                if part.nrows_known == 0:
+                    # keep one empty partial: its columns carry the same
+                    # stub dtypes the unblocked empty-aggregate output uses
+                    empty_partial = part
+                    continue
+                if merged is None:
+                    merged = part
+                else:
+                    cat = self._concat(merged, part)
+                    ctx["max_table_cap"] = max(
+                        ctx["max_table_cap"], cat.cap
+                    )
+                    merged = self._aggregate_once(
+                        key_merge, ctx["casc_aggs"], None, cat,
+                        cat.row_mask(), cat.nrows_known,
+                    )
+        if merged is None:
+            merged = empty_partial  # every window filtered to nothing
+        return merged
+
+    def _finish_blocked_union(self, node: P.Aggregate, ctx) -> Table:
+        """The plain (non-grouping-sets) blocked aggregate: one windowed
+        level, visible avgs derived, declared column order restored."""
+        merged = self._blocked_union_once(node, ctx, None)
+        out = _derive_rollup_avgs(merged, ctx["avg_items"])
+        # restore the declared output column order (and drop the hidden
+        # __cs_/__cc_ avg-decomposition columns)
+        out = out.select(
+            [n for _, n in node.keys]
+            + [n for _, n in node.aggs if n in out.columns]
+        )
+        self._annotate_blocked(node, ctx)
+        return out
+
+    def _annotate_blocked(self, node: P.Aggregate, ctx):
+        # plan-introspection aids (tests/tools): window count and the peak
+        # per-window table capacity actually touched, which must stay
+        # bounded by the window bucket — never by the total union rows
+        node.blocked_windows = ctx["windows"]
+        node.blocked_stats = self.last_blocked_union = {
+            "windows": ctx["windows"],
+            "window_rows": ctx["window_rows"],
+            "window_cap": ctx["window_cap"],
+            "total_rows": ctx["total_rows"],
+            "max_table_cap": ctx["max_table_cap"],
+        }
+        # session-level marker: harness loops (bench.py) read this to tell
+        # whether the statement they just ran routed through the blocked
+        # path (they reset it before each statement)
+        session = getattr(self.catalog, "session", None)
+        if session is not None:
+            session.last_blocked_union = self.last_blocked_union
+
+    def _union_branch_aligners(self, tables):
+        """Per-branch WINDOW aligners: unify column names (leftmost branch
+        wins, as in SetOp output), dtypes (common promotion) and string
+        dictionaries across union branches, mirroring _concat's per-pair
+        unification so windowed evaluation sees the same values the
+        unblocked concat chain would. The cast/remap itself is deferred to
+        each window slice — aligning the full branches up front would
+        allocate branch-scale copies and reintroduce exactly the peak-HBM
+        spike the blocked path exists to avoid; only dictionary-sized remap
+        tables are built here. Returns one [(out_name, fn(Column)->Column)]
+        list per branch, positionally aligned with the branch's columns."""
+        import pyarrow.compute as pc
+
+        from .expr import _common_dtype
+
+        names = list(tables[0].columns)
+        per_table = [list(t.columns.values()) for t in tables]
+        aligners = [[] for _ in tables]
+        for ci, name in enumerate(names):
+            cols = [cols_t[ci] for cols_t in per_table]
+            if any(c.dtype.is_string for c in cols):
+                dicts = [
+                    (
+                        c.dictionary
+                        if c.dictionary is not None
+                        else pa.array([], pa.string())
+                    ).cast(pa.string())
+                    for c in cols
+                ]
+                unified = pc.unique(pa.concat_arrays(dicts))
+                for bi, d in enumerate(dicts):
+                    if len(d) == 0:
+
+                        def fn(col, _u=unified):
+                            return Column(col.data, col.dtype, col.valid, _u)
+
+                    else:
+                        remap = jnp.asarray(
+                            pc.index_in(d, unified)
+                            .to_numpy(zero_copy_only=False)
+                            .astype(np.int32)
+                        )
+
+                        def fn(col, _r=remap, _u=unified, _n=len(d)):
+                            return Column(
+                                _r[jnp.clip(col.data, 0, _n - 1)],
+                                col.dtype,
+                                col.valid,
+                                _u,
+                            )
+
+                    aligners[bi].append((name, fn))
+            else:
+                dt = _common_dtype([c.dtype for c in cols])
+
+                def fn(col, _dt=dt):
+                    return _cast_column(col, _dt, col.data.shape[0])
+
+                for bi in range(len(tables)):
+                    aligners[bi].append((name, fn))
+        return aligners
 
     def _agg_input(self, node: P.Aggregate):
         """Aggregation input as (table, live mask, known row count|None).

@@ -68,6 +68,11 @@ class Aggregate(PlanNode):
     aggs: list  # (E.Agg, out_name)
     child: PlanNode = None
     grouping_sets: Optional[list] = None  # list of key-index subsets (rollup)
+    # planner annotation (mark_blocked_union_aggs): the input is a union_all
+    # chain reachable through Project/Filter wrappers, so the executor may
+    # evaluate it in bounded row windows with partial-aggregate merging
+    # instead of materializing the full concat (the SF10 HBM ceiling)
+    blocked_union: bool = False
 
     def children(self):
         return [self.child]
@@ -198,6 +203,94 @@ def fingerprint(node: PlanNode) -> str:
 
     emit(node)
     return hashlib.sha256("\x00".join(out).encode()).hexdigest()
+
+
+def _peel_wrappers(n):
+    """(Project/Filter wrapper list top-down, first non-wrapper node)."""
+    wrappers = []
+    while isinstance(n, (Project, Filter)):
+        wrappers.append(n)
+        n = n.child
+    return wrappers, n
+
+
+def union_agg_shape(node: "Aggregate"):
+    """(outer_wrappers, join, inner_wrappers, union branch plans) when an
+    Aggregate's input is a union_all chain reachable through Project/Filter
+    wrappers — optionally with one inner MultiJoin in between whose
+    relations include the union (the query5 shape: a fact-scale
+    sales+returns union joined to dimension tables before the channel
+    aggregation; inner joins distribute over union rows, so windows can
+    flow straight through the join). `join` is None for the direct shape,
+    else `(multijoin_node, union_relation_index)`. Returns None when the
+    input is not this shape.
+
+    Shared by the planner's annotation pass and the executor's blocked
+    union-aggregation path so the two recognize exactly the same shapes.
+    Only pure `union_all` chains qualify: UNION (distinct), INTERSECT and
+    EXCEPT have whole-input set semantics that do not decompose over row
+    windows, so such a SetOp terminates branch flattening instead."""
+    outer, n = _peel_wrappers(node.child)
+    join = None
+    inner = []
+    if isinstance(n, MultiJoin):
+        # the FIRST union-shaped relation is the windowed side; every other
+        # relation executes once and joins against each window
+        for i, r in enumerate(n.relations):
+            w, m = _peel_wrappers(r)
+            if isinstance(m, SetOp) and m.op == "union_all":
+                join = (n, i)
+                inner = w
+                n = m
+                break
+        if join is None:
+            return None
+    if not (isinstance(n, SetOp) and n.op == "union_all"):
+        return None
+    branches = []
+
+    def collect(x):
+        if isinstance(x, SetOp) and x.op == "union_all":
+            collect(x.left)
+            collect(x.right)
+        else:
+            branches.append(x)
+
+    collect(n)
+    return outer, join, inner, branches
+
+
+def mark_blocked_union_aggs(node: PlanNode) -> int:
+    """Annotate every Aggregate (anywhere in the tree, subquery plans
+    included) whose input is a union_all chain: sets `blocked_union` so the
+    executor may take the windowed partial-aggregation path. Grouping-set
+    aggregates qualify too — their from-scratch levels run windowed and
+    the rollup cascade re-aggregates the (small) results. Returns the
+    number of nodes marked (plan-introspection aid for tests/tools)."""
+    import dataclasses
+
+    marked = 0
+    seen = set()
+
+    def visit(v):
+        nonlocal marked
+        if isinstance(v, (PlanNode, E.Expr)):
+            if id(v) in seen:
+                return
+            seen.add(id(v))
+            if isinstance(v, Aggregate) and union_agg_shape(v) is not None:
+                v.blocked_union = True
+                marked += 1
+            # generic field recursion reaches subquery plans riding inside
+            # expressions (E.ScalarSubquery.plan) as well as plan children
+            for f in dataclasses.fields(v):
+                visit(getattr(v, f.name))
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                visit(x)
+
+    visit(node)
+    return marked
 
 
 def explain(node: PlanNode, indent=0) -> str:

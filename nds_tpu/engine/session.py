@@ -379,10 +379,13 @@ class Result:
         self.session = session
         self.plan = plan_node
         self._table = None
+        self.executor = None  # kept so callers can read per-query stats
+        # (e.g. last_blocked_union) without racing other sessions' threads
 
     def table(self) -> Table:
         if self._table is None:
-            self._table = self.session._executor().execute(self.plan)
+            self.executor = self.session._executor()
+            self._table = self.executor.execute(self.plan)
         return self._table
 
     def collect(self) -> pa.Table:
@@ -444,11 +447,41 @@ class Session:
         self.plan_cache = _PlanResultCache(
             int(self.conf.get("engine.plan_cache_bytes", 1 << 30))
         )
+        # stats of the most recent blocked union-aggregation any executor
+        # of this session ran (bench.py's OOM-bail heuristic reads it)
+        self.last_blocked_union = None
 
     def _catalog_changed(self):
         """Any registration/drop/invalidation: cached plan results may now
         be stale — drop them all."""
         self.plan_cache.clear()
+
+    # blocked union-aggregation windows get this fraction of the catalog's
+    # device budget (the window buffers coexist with cached base tables and
+    # the per-window partial-aggregation intermediates)
+    _UNION_AGG_WINDOW_BUDGET_FRACTION = 16
+
+    def union_agg_window_rows(self, row_bytes: int) -> int:
+        """Rows per window for blocked union-aggregation (engine/exec.py).
+
+        Resolution order: `engine.union_agg_window_rows` session conf, then
+        the NDS_UNION_AGG_WINDOW_ROWS env knob (both honored exactly — tests
+        force tiny windows through them), else derived from the per-session
+        HBM budget the catalog already tracks: a window of `row_bytes`-wide
+        rows gets ~1/16 of DEVICE_BUDGET_BYTES, rounded down to a power of
+        two so slice shapes stay stable, clamped to [64Ki, 16Mi] rows."""
+        v = self.conf.get("engine.union_agg_window_rows") or os.environ.get(
+            "NDS_UNION_AGG_WINDOW_ROWS"
+        )
+        if v:
+            return max(int(v), 1)
+        budget = (
+            self.catalog.DEVICE_BUDGET_BYTES
+            // self._UNION_AGG_WINDOW_BUDGET_FRACTION
+        )
+        rows = max(budget // max(row_bytes, 1), 1)
+        pow2 = 1 << (rows.bit_length() - 1)  # round DOWN: stay within budget
+        return int(min(max(pow2, 1 << 16), 1 << 24))
 
     # ---- registration ----------------------------------------------------
     def register_arrow(self, name, arrow: pa.Table, schema=None):
@@ -563,11 +596,13 @@ class Session:
             binder = Binder(self.catalog)
             plan = binder.bind(stmt)
             plan = prune_columns(plan, self.catalog)
+            P.mark_blocked_union_aggs(plan)
             return Result(self, plan)
         if isinstance(stmt, A.CreateViewStmt):
             binder = Binder(self.catalog)
             plan = binder.bind(stmt.query)
             plan = prune_columns(plan, self.catalog)
+            P.mark_blocked_union_aggs(plan)
             arrow = Result(self, plan).collect()
             self.register_arrow(stmt.name, arrow)
             return None

@@ -282,15 +282,17 @@ def _to_ts_ms(ts) -> int:
             v = float(ts)  # CLI args arrive as strings
         except ValueError:
             v = None
-        # only plausible epoch magnitudes (~2001..2286 in seconds or ms): a
-        # dash-less date like '20240101' or '20240101120000' must fall
-        # through to the date parser and error loudly, not be taken as an
-        # epoch in 1970 or 2611
-        if v is not None and 10**9 <= v < 10**13:
+        # only plausible epoch magnitudes: seconds in [1e9, 1e10) (~2001..
+        # 2286) or milliseconds in [1e12, 1e13) (same era). Anything else —
+        # dash-less dates like '20240101' (8 digits), '202401011200'
+        # (12 digits, ~2e11) or '20240101120000' (14 digits, ~2e13) — must
+        # fall through to the date parser and error loudly, not be taken
+        # as an epoch in 1970, 8383 or 2611
+        if v is not None and (10**9 <= v < 10**10 or 10**12 <= v < 10**13):
             ts = v
     if isinstance(ts, (int, float)):
         # numeric: epoch seconds (fractional ok) or ms if large
-        return int(ts if ts > 10**12 else ts * 1000)
+        return int(ts if ts >= 10**12 else ts * 1000)
     from datetime import datetime
 
     s = str(ts).strip()

@@ -521,12 +521,31 @@ def join_candidates(lkeys, lvalids, llive, rkeys, rvalids, rlive):
     rnn = _all_valid(rvalids, rlive)
     rh_sorted, rorder = _join_prepare(rh, rnn)
     lo, counts = _join_counts(rh_sorted, lh, lnn)
-    total = int(jnp.sum(counts))
+    # int64 reduction + host-side guard: _join_expand's owner-assignment
+    # arithmetic (exclusive cumsum, positions) runs in int32 for speed, so
+    # a candidate total past 2^31 would silently wrap into garbage pair
+    # indices. Fail loudly instead (such an out_cap wouldn't allocate
+    # anyway; the realistic trigger is a pathological cross-join-like key).
+    total = int(jnp.sum(counts, dtype=jnp.int64))
+    _check_pair_count(total)
     from ..engine.columnar import bucket_cap
 
     out_cap = bucket_cap(max(total, 1))
     li, ri, pair_live = _join_expand(lo, counts, rorder, out_cap)
     return li, ri, pair_live, total
+
+
+def _check_pair_count(total: int):
+    """Host-side int32-range guard for join candidate expansion: the
+    output capacity is the next power-of-two bucket >= total, and that cap
+    itself must stay an int32 value (it is used as the parked-row sentinel
+    in the owner scatter), so the largest safe bucket is 2^30."""
+    if total > 1 << 30:
+        raise ValueError(
+            f"join candidate count {total} exceeds the int32-safe "
+            f"expansion capacity (2^30); refusing to expand (the int32 "
+            f"pair arithmetic would wrap silently)"
+        )
 
 
 def _all_valid(valids, live):
