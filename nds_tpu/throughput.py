@@ -28,7 +28,64 @@ def round_up_to_nearest_10_percent(num: float) -> float:
 
 
 class _GateBroken(RuntimeError):
-    """A stream's start-gate rendezvous failed (sibling error or timeout)."""
+    """A stream's start-gate rendezvous failed (a sibling stream errored)."""
+
+
+class _StartGate:
+    """Aligned-start rendezvous for concurrent streams.
+
+    All streams park in wait() and share one release timestamp (the barrier
+    action runs in exactly one thread at trip time). Failure semantics:
+
+    - a sibling erroring during setup calls abort() -> every parked wait()
+      raises _GateBroken (the run fails with the root cause);
+    - a PURE timeout (some stream is slow but nothing errored) degrades to
+      ungated per-stream starts: each wait() returns its own clock instead
+      of failing the whole run (the pre-gate behavior — a slow setup used
+      to work, just unaligned, and must keep working).
+
+    `timeout` defaults to the NDS_THROUGHPUT_GATE_TIMEOUT env knob
+    (seconds, default 600)."""
+
+    def __init__(self, n_streams: int, timeout: float = None):
+        if timeout is None:
+            timeout = float(
+                os.environ.get("NDS_THROUGHPUT_GATE_TIMEOUT", "600")
+            )
+        self.timeout = timeout
+        self._epoch = {}
+        self._aborted = threading.Event()
+        self._barrier = threading.Barrier(
+            n_streams,
+            action=lambda: self._epoch.__setitem__("t", time.time()),
+        )
+
+    def wait(self) -> float:
+        try:
+            self._barrier.wait(timeout=self.timeout)
+        except threading.BrokenBarrierError:
+            if self._aborted.is_set():
+                raise _GateBroken(
+                    "stream start gate broken: a sibling stream failed "
+                    "during setup"
+                ) from None
+            # pure timeout: this (or a sibling's) wait outlived the budget
+            # with no error anywhere — fall back to an ungated start. Say
+            # so: Ttt loses its structural aligned-start guarantee here,
+            # and the run output must make that auditable.
+            import sys
+
+            print(
+                f"throughput start gate timed out after {self.timeout:.0f}s;"
+                f" falling back to ungated per-stream starts",
+                file=sys.stderr,
+            )
+            return time.time()
+        return self._epoch["t"]
+
+    def abort(self):
+        self._aborted.set()
+        self._barrier.abort()  # release siblings still parked at the gate
 
 
 def _read_start_end(time_log_path: str):
@@ -56,6 +113,7 @@ def run_throughput(
     output_format="parquet",
     mode="thread",
     sub_queries=None,
+    gate_timeout=None,
 ):
     """Run the streams in `stream_paths` ({stream_num: stream_file})
     concurrently; write `<time_log_base>_<n>.csv` per stream; return Ttt
@@ -75,26 +133,12 @@ def run_throughput(
         )
     errors = {}
     # All streams rendezvous after table setup, before their Power clocks
-    # start, and share ONE release timestamp (the barrier action runs in
-    # exactly one thread at trip time): overlap of the [start, end] windows
-    # is then structural, immune to the 1-core host scheduling one thread's
-    # first query before another thread gets to read its own clock. A
-    # stream that errors before reaching the gate aborts it for everyone
-    # rather than deadlocking the rest.
-    epoch = {}
-    gate = threading.Barrier(
-        len(stream_paths), action=lambda: epoch.__setitem__("t", time.time())
-    )
-
-    def start_gate():
-        try:
-            gate.wait(timeout=600)
-        except threading.BrokenBarrierError:
-            raise _GateBroken(
-                "stream start gate broken: a sibling stream failed during "
-                "setup, or setup exceeded the 600 s gate timeout"
-            ) from None
-        return epoch["t"]
+    # start (see _StartGate): overlap of the [start, end] windows is then
+    # structural, immune to the 1-core host scheduling one thread's first
+    # query before another thread gets to read its own clock. A stream that
+    # errors before reaching the gate aborts it for everyone rather than
+    # deadlocking the rest; a pure timeout degrades to ungated starts.
+    gate = _StartGate(len(stream_paths), timeout=gate_timeout)
 
     def one_stream(n, path):
         try:
@@ -122,11 +166,11 @@ def run_throughput(
                     f"{output_path}_{n}" if output_path else None
                 ),
                 output_format=output_format,
-                start_gate=start_gate,
+                start_gate=gate.wait,
             )
         except Exception as exc:
             errors[n] = exc
-            gate.abort()  # release siblings still parked at the gate
+            gate.abort()
 
     threads = [
         threading.Thread(target=one_stream, args=(n, p), name=f"stream-{n}")
