@@ -243,13 +243,15 @@ def bench_geomean(sess, block=None, scale=None, wall_budget=None):
                     box["blocked"] = True
                 return err
 
+            from nds_tpu import faults
+
             err = attempt()
-            if err is not None and "RESOURCE_EXHAUSTED" in err:
+            if err is not None and faults.classify(err) == faults.DEVICE_OOM:
                 # mid-execution device OOM: drop caches, retry once on a
                 # clean device (one OOM must not poison the stream)
                 sess.recover_memory("device memory exhausted")
                 err = attempt()
-                if err is not None and "RESOURCE_EXHAUSTED" in err:
+                if err is not None and faults.classify(err) == faults.DEVICE_OOM:
                     sess.recover_memory("device memory exhausted")
             if err is None:
                 box["ok"] = True
@@ -356,7 +358,9 @@ def bench_geomean(sess, block=None, scale=None, wall_budget=None):
             print(f"[{i + 1}/{len(queries)}] {name}: FAILED {exc}",
                   file=sys.stderr)
             update_out()
-            if "RESOURCE_EXHAUSTED" in failed[name]:
+            from nds_tpu import faults as _faults
+
+            if _faults.classify(failed[name]) == _faults.DEVICE_OOM:
                 # Queries that routed through the blocked union-aggregation
                 # path (the SF10 OOM source, query5 and kin) no longer feed
                 # the bail: their OOM is a per-query error worth recording,

@@ -71,7 +71,24 @@ def main(argv=None):
         help="comma separated list of queries to run, e.g. 'query1,query2'. "
         "Use _part1/_part2 suffixes for queries 14, 23, 24, 39.",
     )
+    parser.add_argument(
+        "--query_timeout",
+        type=float,
+        help="per-query watchdog budget in seconds: a query still running "
+        "after this long is recorded as a classified 'timeout' failure and "
+        "the stream moves on (conf engine.query_timeout; env "
+        "NDS_QUERY_TIMEOUT)",
+    )
+    parser.add_argument(
+        "--fault_spec",
+        help="fault-injection spec (conf engine.fault_spec; env "
+        "NDS_FAULT_SPEC), e.g. 'oom:query5:1;hang:query9:30'",
+    )
     args = parser.parse_args(argv)
+    if args.fault_spec:
+        from .. import faults
+
+        faults.install(args.fault_spec)
     query_dict = gen_sql_from_stream(args.query_stream_file)
     run_query_stream(
         input_prefix=args.input_prefix,
@@ -86,6 +103,7 @@ def main(argv=None):
         output_format=args.output_format,
         json_summary_folder=args.json_summary_folder,
         mesh_devices=args.mesh_devices,
+        query_timeout=args.query_timeout,
     )
 
 

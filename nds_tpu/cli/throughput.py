@@ -47,6 +47,13 @@ def main(argv=None):
         "--sub_queries", type=lambda s: [x.strip() for x in s.split(",")],
         help="comma separated subset of queries to run in each stream",
     )
+    parser.add_argument(
+        "--query_timeout",
+        type=float,
+        help="per-query watchdog budget in seconds (a hung query becomes a "
+        "classified 'timeout' failure instead of stalling the stream's Ttt "
+        "window); also bounds process-mode child waits",
+    )
     args = parser.parse_args(argv)
     nums = [int(s) for s in args.streams.split(",") if s.strip()]
     stream_paths = {
@@ -64,6 +71,7 @@ def main(argv=None):
         output_format=args.output_format,
         mode=args.mode,
         sub_queries=args.sub_queries,
+        query_timeout=args.query_timeout,
     )
     print(f"====== Throughput Test Time: {ttt} seconds ======")
 

@@ -187,6 +187,7 @@ class Executor:
         self._fp_cache = {}  # id(plan) -> structural fingerprint
         # stats of the most recent blocked union-aggregation (tests/tools)
         self.last_blocked_union = None
+        self._fault_checked = False  # exec-root injection fires once
 
     # plan-node types worth caching across statements: the expensive
     # pipeline breakers (a CTE body virtually always ends in one)
@@ -209,6 +210,19 @@ class Executor:
 
     # ------------------------------------------------------------------
     def execute(self, node: P.PlanNode) -> Table:
+        if not self._fault_checked:
+            # failure-domain injection site at the executor root (once per
+            # executor, i.e. per statement): `exec:<query>` faults fire
+            # inside the engine proper, past plan/bind, so the harness
+            # ladder sees exactly what a mid-execution device failure
+            # looks like. Zero-cost when no fault spec is installed.
+            self._fault_checked = True
+            from .. import faults as F
+
+            if F.active():
+                scope = F.current_scope()
+                if scope is not None:
+                    F.maybe_fire(f"exec:{scope}")
         key = id(node)
         if key in self._cte_cache:
             return self._cte_cache[key]

@@ -243,6 +243,13 @@ class Catalog:
         if columns is None:
             sch = self.schema(name)
             columns = sch.names
+        from .. import faults
+
+        if faults.active():
+            # io/oom injection site for table loads (e.g. io:store_sales:2
+            # exercises the transient-IO ladder rung end to end)
+            faults.maybe_fire(f"load:{name}")
+            faults.maybe_fire(name)
         missing = [c for c in columns if c not in e.device_cols]
         if missing:
 
@@ -441,6 +448,13 @@ class Session:
         _enable_persistent_compile_cache()
         self.use_decimal = use_decimal
         self.conf = dict(conf or {})  # engine options (property-file tier)
+        # failure-domain: install any configured fault-injection spec
+        # (conf engine.fault_spec / env NDS_FAULT_SPEC) so engine-level
+        # injection points are armed; idempotent for an unchanged spec, so
+        # per-stream sessions in a throughput run share one fire budget
+        from .. import faults
+
+        faults.install_from_env(self.conf)
         self.mesh = mesh
         self.catalog = Catalog(self)
         self._listeners = []  # task-failure observers (harness parity)

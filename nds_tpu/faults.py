@@ -1,0 +1,336 @@
+"""Failure-domain core: fault injection + a classified failure taxonomy.
+
+The reference harness inherits its failure semantics from Spark — executor
+loss becomes a task retry, a hung task is killed by the scheduler, and the
+TaskFailureListener chain surfaces what happened (reference:
+nds/jvm_listener/.../TaskFailureListener.scala:13-19). This engine has no
+scheduler underneath it, so the equivalent failure domain lives here:
+
+* a deterministic fault-injection registry (chaos-harness style) so every
+  recovery path in the harness can be exercised on demand instead of hoping
+  it fires correctly under a real OOM;
+* a failure taxonomy (`classify`) replacing ad-hoc string matching, so the
+  retry/degradation ladder in report.py and the phase retries in
+  full_bench.py agree on what is transient and what is deterministic.
+
+Fault spec grammar (conf `engine.fault_spec` / env `NDS_FAULT_SPEC`):
+
+    spec  := rule (';' rule)*
+    rule  := kind ':' site [':' arg]
+    kind  := oom | hostoom | io | hang | crash
+    site  := free-form label matched against injection points
+
+e.g. ``oom:query5:1;io:store_sales:2;hang:query9:30;crash:power_test``.
+
+`arg` is the number of times the rule fires (default 1) — except for
+`hang`, where it is the number of seconds to sleep (the rule fires once).
+Injection sites fired around the codebase:
+
+    <query_name>          power/maintenance driver, per stream entry
+    exec:<query_name>     executor root, inside the engine proper
+    load:<table_name>     catalog device load of a registered table
+    commit:<table_name>   lakehouse manifest commit
+    <phase_name>          full_bench phase runner (e.g. power_test)
+    any path substring    fs_open (fired via maybe_fire_path)
+
+The registry is a module singleton; when no spec is installed every
+injection point is a single ``is None`` check (zero-cost in production).
+Counts decrement under a lock so concurrent throughput streams share one
+deterministic budget.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+# ---------------------------------------------------------------------------
+# failure taxonomy
+# ---------------------------------------------------------------------------
+
+DEVICE_OOM = "device_oom"  # accelerator memory exhausted (recover + retry)
+HOST_OOM = "host_oom"  # host allocation failed (recover + retry)
+IO_TRANSIENT = "io_transient"  # flaky storage/network (backoff + retry)
+TIMEOUT = "timeout"  # watchdog fired (no retry: likely hangs again)
+PLANNER = "planner"  # parse/bind/exec logic error (deterministic)
+DATA = "data"  # malformed input data (deterministic)
+UNKNOWN = "unknown"
+
+#: kinds a retry can plausibly fix; everything else fails fast
+RETRYABLE = frozenset({DEVICE_OOM, HOST_OOM, IO_TRANSIENT})
+
+_DEVICE_OOM_PAT = ("RESOURCE_EXHAUSTED", "Out of memory allocating")
+_HOST_OOM_PAT = (
+    "MemoryError",
+    "Cannot allocate memory",
+    "std::bad_alloc",
+    "Unable to allocate",
+    "host OOM",  # InjectedHostOOM renders as "injected host OOM at ..."
+)
+_TIMEOUT_PAT = ("watchdog", "DEADLINE_EXCEEDED")
+_IO_PAT = (
+    "transient io",
+    "Connection reset",
+    "Connection aborted",
+    "ConnectionError",
+    "Broken pipe",
+    "Temporary failure",
+    "temporarily unavailable",
+    "EAGAIN",
+    "timed out",
+    "TimeoutError",
+    "SlowDown",
+    "Slow Down",
+    # anchored: a bare "503" would match row counts / shapes in unrelated
+    # error text, and XLA InternalError is deterministic, not transient
+    "HTTP 503",
+    "503 Service",
+)
+_PLANNER_PAT = ("ParseError", "BindError", "ExecError", "SyntaxError")
+_DATA_PAT = ("malformed", "LakehouseError", "schema mismatch", "Invalid value")
+
+
+def classify(err) -> str:
+    """Map an exception (or its rendered text) to a taxonomy kind.
+
+    Order matters: the watchdog marker contains "timed out"-adjacent words,
+    so TIMEOUT is checked before IO_TRANSIENT; device OOM before host OOM
+    (XLA OOM text can mention allocation too)."""
+    if isinstance(err, BaseException):
+        text = f"{type(err).__name__}: {err}"
+        if isinstance(err, MemoryError):
+            return HOST_OOM
+        if isinstance(err, (ConnectionError, TimeoutError)):
+            return IO_TRANSIENT
+    else:
+        text = str(err)
+    for pat in _DEVICE_OOM_PAT:
+        if pat in text:
+            return DEVICE_OOM
+    for pat in _HOST_OOM_PAT:
+        if pat in text:
+            return HOST_OOM
+    for pat in _TIMEOUT_PAT:
+        if pat in text:
+            return TIMEOUT
+    for pat in _IO_PAT:
+        if pat in text:
+            return IO_TRANSIENT
+    for pat in _PLANNER_PAT:
+        if pat in text:
+            return PLANNER
+    for pat in _DATA_PAT:
+        if pat in text:
+            return DATA
+    return UNKNOWN
+
+
+def backoff_delays(retries: int, base: float, cap: float = 30.0):
+    """Exponential backoff with full jitter: delay_i ~ U(0, base * 2**i],
+    capped. Deterministic tests set base ~ 0 so the jitter vanishes."""
+    import random
+
+    for i in range(retries):
+        yield random.uniform(0, min(base * (2 ** i), cap)) if base > 0 else 0.0
+
+
+# ---------------------------------------------------------------------------
+# injected fault exceptions
+# ---------------------------------------------------------------------------
+
+
+class FaultError(Exception):
+    """Base for injected faults (except crash, which must not be caught)."""
+
+
+class InjectedOOM(FaultError):
+    """Renders with RESOURCE_EXHAUSTED so it classifies (and is handled)
+    exactly like a real XLA device OOM."""
+
+
+class InjectedHostOOM(FaultError, MemoryError):
+    pass
+
+
+class TransientIOError(FaultError, OSError):
+    """Renders with 'transient io' so it classifies as IO_TRANSIENT."""
+
+
+class InjectedCrash(BaseException):
+    """Simulated process death. Derives from BaseException so it sails
+    through every `except Exception` recovery layer (like a SIGKILL would):
+    the phase subprocess exits nonzero, the orchestrator stops at its last
+    checkpoint."""
+
+
+# ---------------------------------------------------------------------------
+# fault registry
+# ---------------------------------------------------------------------------
+
+_KINDS = ("oom", "hostoom", "io", "hang", "crash")
+
+
+class FaultRule:
+    __slots__ = ("kind", "site", "arg", "remaining")
+
+    def __init__(self, kind: str, site: str, arg: float):
+        self.kind = kind
+        self.site = site
+        self.arg = arg
+        # hang sleeps `arg` seconds and fires once; others fire `arg` times
+        self.remaining = 1 if kind == "hang" else int(arg)
+
+    def __repr__(self):
+        return f"FaultRule({self.kind}:{self.site}:{self.arg}, remaining={self.remaining})"
+
+
+class FaultRegistry:
+    def __init__(self, rules):
+        self.rules = list(rules)
+        self._lock = threading.Lock()
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultRegistry":
+        rules = []
+        for part in str(spec).split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            bits = part.split(":")
+            if len(bits) < 2 or bits[0] not in _KINDS or not bits[1]:
+                raise ValueError(
+                    f"bad fault rule {part!r} (want kind:site[:arg] with "
+                    f"kind in {_KINDS})"
+                )
+            kind = bits[0]
+            # sites may themselves contain ':' (e.g. exec:query3); a rule's
+            # trailing segment is the arg only if it parses as a number
+            arg, site_bits = 1.0, bits[1:]
+            if len(site_bits) > 1:
+                try:
+                    arg = float(site_bits[-1])
+                    site_bits = site_bits[:-1]
+                except ValueError:
+                    pass
+            rules.append(FaultRule(kind, ":".join(site_bits), arg))
+        return cls(rules)
+
+    def _claim(self, site: str, substring: bool, kinds=None):
+        with self._lock:
+            for r in self.rules:
+                if r.remaining <= 0 or (kinds is not None and r.kind not in kinds):
+                    continue
+                hit = (r.site in site) if substring else (r.site == site)
+                if hit:
+                    r.remaining -= 1
+                    return r
+        return None
+
+    def fire(self, site: str, substring: bool = False, kinds=None):
+        r = self._claim(site, substring, kinds)
+        if r is None:
+            return
+        if r.kind == "hang":
+            print(f"faults: injected hang at {site!r} for {r.arg:.0f}s")
+            time.sleep(r.arg)
+            return
+        if r.kind == "oom":
+            raise InjectedOOM(
+                f"RESOURCE_EXHAUSTED: injected device OOM at {site!r}"
+            )
+        if r.kind == "hostoom":
+            raise InjectedHostOOM(f"injected host OOM at {site!r}")
+        if r.kind == "io":
+            raise TransientIOError(f"injected transient io failure at {site!r}")
+        if r.kind == "crash":
+            raise InjectedCrash(f"injected crash at {site!r}")
+
+
+# module singleton; None == injection disabled (the zero-cost path)
+_registry: FaultRegistry | None = None
+_installed_spec: str | None = None
+
+
+def install(spec: str | None):
+    """(Re)build the registry from a spec string; None/"" disables injection.
+    Idempotent for an unchanged spec so that per-stream Session construction
+    does not reset the shared fire counts mid-run."""
+    global _registry, _installed_spec
+    if spec == _installed_spec:
+        return
+    _installed_spec = spec
+    _registry = FaultRegistry.parse(spec) if spec else None
+
+
+def install_from_env(conf: dict | None = None):
+    """Install from conf `engine.fault_spec`, falling back to NDS_FAULT_SPEC.
+    Called by Session construction and the full_bench orchestrator so a spec
+    set in either tier reaches every injection point in the process."""
+    spec = None
+    if conf:
+        spec = conf.get("engine.fault_spec")
+    spec = spec or os.environ.get("NDS_FAULT_SPEC")
+    if spec:
+        install(str(spec))
+
+
+def reset():
+    global _registry, _installed_spec
+    _registry = None
+    _installed_spec = None
+
+
+def active() -> bool:
+    return _registry is not None
+
+
+def maybe_fire(site: str):
+    """Exact-match injection point. A single None check when no spec is
+    installed."""
+    if _registry is None:
+        return
+    _registry.fire(site)
+
+
+def maybe_fire_path(path):
+    """Substring-match injection point for filesystem paths (a rule site
+    `store_sales` hits any IO touching that table's files). Only io/crash
+    rules match here: an `oom:query5` rule is about the query site, and a
+    report filename that happens to contain "query5" must not trip it."""
+    if _registry is None:
+        return
+    _registry.fire(str(path), substring=True, kinds=("io", "crash"))
+
+
+# ---------------------------------------------------------------------------
+# thread-local scope (which query is executing) for engine-level sites
+# ---------------------------------------------------------------------------
+
+_scope = threading.local()
+
+
+class scope:
+    """Context manager labelling the currently-executing query so deeper
+    layers (the executor root) can fire scoped sites like exec:<query>."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        self.prev = getattr(_scope, "name", None)
+        _scope.name = self.name
+        return self
+
+    def __exit__(self, *exc):
+        _scope.name = self.prev
+        return False
+
+
+def current_scope():
+    return getattr(_scope, "name", None)
+
+
+# late import installs the env-tier spec for processes that never build a
+# Session (e.g. the orchestrator parent)
+install_from_env()

@@ -15,17 +15,30 @@ Each phase shells out to its CLI (process boundary, like the reference's
 subprocess.run of spark-submit) and state passes through report files on
 disk, so any phase can be skipped and resumed from prior reports
 (reference: nds_bench.py:367-497; skip semantics nds/README.md:499-503).
+
+Failure domain: the orchestrator checkpoints an atomically-written
+`bench_state.json` after every completed phase, `--resume` derives the
+skip set from it (no more manual `skip:` editing after a multi-hour run
+dies), classified-transient phase failures retry with a bounded budget
+(NDS_PHASE_RETRIES), and every phase runner is a fault-injection site
+(e.g. `crash:power_test` in NDS_FAULT_SPEC) so the resume path is
+deterministically testable.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import math
 import os
 import subprocess
 import sys
+import time
 
 import yaml
 
+from . import faults
+from .io.fs import fs_open, fs_open_atomic, get_fs, is_remote
 from .throughput import round_up_to_nearest_10_percent
 
 
@@ -132,9 +145,101 @@ def get_perf_metric(scale_factor, sq, tload, tpower, ttt1, ttt2, tdm1, tdm2):
 
 
 def write_metrics_report(report_path, metrics_map):
-    with open(report_path, "w") as f:
+    with fs_open_atomic(report_path, "w") as f:
         for key, value in metrics_map.items():
             f.write(f"{key},{value}\n")
+
+
+# ---------------------------------------------------------------------------
+# checkpoint state (crash-safe resume without manual `skip:` editing)
+# ---------------------------------------------------------------------------
+
+#: orchestrator phase order; bench_state.json records completion per name
+PHASES = (
+    "data_gen",
+    "load_test",
+    "gen_streams",
+    "power_test",
+    "throughput_test_1",
+    "maintenance_test_1",
+    "throughput_test_2",
+    "maintenance_test_2",
+)
+
+
+def params_fingerprint(params) -> str:
+    """Stable digest of the bench config: a resume against a different
+    config would silently mix phase outputs from two benchmarks."""
+    blob = json.dumps(params, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def bench_state_path(params) -> str:
+    explicit = params.get("bench_state_path")
+    if explicit:
+        return str(explicit)
+    base = os.path.dirname(str(params.get("metrics_report_path", "")))
+    return os.path.join(base, "bench_state.json") if base else "bench_state.json"
+
+
+class BenchState:
+    """Phase-completion checkpoint, atomically rewritten after every phase
+    so the on-disk file is always a complete, parseable snapshot."""
+
+    def __init__(self, path: str, fingerprint: str, phases=None):
+        self.path = path
+        self.fingerprint = fingerprint
+        self.phases = dict(phases or {})  # name -> {"completed_at_ms": int}
+
+    @classmethod
+    def fresh(cls, params) -> "BenchState":
+        return cls(bench_state_path(params), params_fingerprint(params))
+
+    @classmethod
+    def load(cls, params) -> "BenchState":
+        """Resume state from disk; a missing file resumes from nothing
+        (equivalent to a fresh run), a config mismatch is a loud error."""
+        path = bench_state_path(params)
+        fp = params_fingerprint(params)
+        # the state file may live on remote storage (it sits next to the
+        # metrics report) — route existence + read through the fs seam
+        if is_remote(path):
+            fs, p = get_fs(path)
+            exists = fs.exists(p)
+        else:
+            exists = os.path.exists(path)
+        if not exists:
+            print(f"resume: no checkpoint at {path}; starting fresh")
+            return cls(path, fp)
+        with fs_open(path) as f:
+            raw = json.load(f)
+        if raw.get("params_fingerprint") != fp:
+            raise ValueError(
+                f"resume: checkpoint {path} was written by a different "
+                f"bench config (fingerprint {raw.get('params_fingerprint')} "
+                f"!= {fp}); delete it or fix the config"
+            )
+        done = sorted(raw.get("phases", {}))
+        print(f"resume: checkpoint {path} has completed phases: {done}")
+        return cls(path, fp, raw.get("phases"))
+
+    def is_done(self, phase: str) -> bool:
+        return phase in self.phases
+
+    def mark_done(self, phase: str):
+        self.phases[phase] = {"completed_at_ms": int(time.time() * 1000)}
+        self._write()
+
+    def _write(self):
+        with fs_open_atomic(self.path, "w") as f:
+            json.dump(
+                {
+                    "params_fingerprint": self.fingerprint,
+                    "phases": self.phases,
+                },
+                f,
+                indent=2,
+            )
 
 
 # ---------------------------------------------------------------------------
@@ -245,9 +350,67 @@ def maintenance_test(params, num_streams, first_or_second):
 
 
 # ---------------------------------------------------------------------------
+# phase execution with checkpointing + classified bounded retries
+# ---------------------------------------------------------------------------
 
 
-def run_full_bench(params):
+class PhaseError(RuntimeError):
+    """A benchmark phase failed terminally (retry budget exhausted or the
+    failure classified as deterministic)."""
+
+    def __init__(self, phase, kind, attempts, cause):
+        super().__init__(
+            f"phase {phase} failed ({kind}) after {attempts} attempt(s): "
+            f"{cause}"
+        )
+        self.phase = phase
+        self.kind = kind
+
+
+def _run_phase(state: BenchState, name: str, skip, fn):
+    """Run one phase with checkpointing and bounded transient retries.
+
+    Phase CLIs are rerun-idempotent (they overwrite their outputs), so a
+    classified-transient failure retries up to NDS_PHASE_RETRIES times
+    with backoff. Deterministic failures (and unclassifiable subprocess
+    exits, unless NDS_PHASE_RETRY_UNKNOWN=1 opts in) raise immediately.
+    An injected crash (BaseException) sails through: the process dies with
+    the checkpoint recording every phase completed before it."""
+    if skip:
+        print(f"====== phase {name}: skipped (config) ======", flush=True)
+        return
+    if state.is_done(name):
+        print(f"====== phase {name}: skipped (checkpoint) ======", flush=True)
+        return
+    retries = int(os.environ.get("NDS_PHASE_RETRIES", "1"))
+    retry_unknown = os.environ.get("NDS_PHASE_RETRY_UNKNOWN") == "1"
+    base = float(os.environ.get("NDS_PHASE_BACKOFF", "1.0"))
+    delays = faults.backoff_delays(retries, base)
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            faults.maybe_fire(name)
+            fn()
+            break
+        except Exception as exc:
+            kind = faults.classify(exc)
+            transient = kind in faults.RETRYABLE or (
+                kind == faults.UNKNOWN and retry_unknown
+            )
+            delay = next(delays, None) if transient else None
+            if delay is None:
+                raise PhaseError(name, kind, attempt, exc) from exc
+            print(
+                f"====== phase {name}: attempt {attempt} failed "
+                f"({kind}: {exc}); retrying in {delay:.1f}s ======",
+                flush=True,
+            )
+            time.sleep(delay)
+    state.mark_done(name)
+
+
+def run_full_bench(params, resume: bool = False):
     num_streams = params["generate_query_stream"]["num_streams"]
     if num_streams % 2 == 0 or num_streams < 3:
         raise ValueError(
@@ -255,33 +418,53 @@ def run_full_bench(params):
             f"non-empty throughput sets; Spec 4.3.2 wants 2*S+1, S>=4), "
             f"got {num_streams}"
         )
+    faults.install_from_env()  # arm orchestrator-level injection sites
+    state = BenchState.load(params) if resume else BenchState.fresh(params)
     sq = num_streams // 2  # streams per Throughput Test
-    if not params["data_gen"].get("skip"):
-        run_data_gen(params, num_streams)
-    if not params["load_test"].get("skip"):
-        run_load_test(params)
+    _run_phase(
+        state, "data_gen", params["data_gen"].get("skip"),
+        lambda: run_data_gen(params, num_streams),
+    )
+    _run_phase(
+        state, "load_test", params["load_test"].get("skip"),
+        lambda: run_load_test(params),
+    )
     load_report = params["load_test"]["report_path"]
     tload = get_load_time(load_report)
-    if not params["generate_query_stream"].get("skip"):
-        gen_streams(params, num_streams, get_load_end_timestamp(load_report))
-    if not params["power_test"].get("skip"):
-        power_test(params)
+    _run_phase(
+        state, "gen_streams", params["generate_query_stream"].get("skip"),
+        lambda: gen_streams(
+            params, num_streams, get_load_end_timestamp(load_report)
+        ),
+    )
+    _run_phase(
+        state, "power_test", params["power_test"].get("skip"),
+        lambda: power_test(params),
+    )
     tpower = get_power_time(params["power_test"]["report_path"])
     tt_cfg = params["throughput_test"]
     dm_cfg = params["maintenance_test"]
-    if not tt_cfg.get("skip"):
-        throughput_test(params, num_streams, 1)
+    _run_phase(
+        state, "throughput_test_1", tt_cfg.get("skip"),
+        lambda: throughput_test(params, num_streams, 1),
+    )
     ttt1 = get_throughput_time(tt_cfg["report_base_path"], num_streams, 1)
-    if not dm_cfg.get("skip"):
-        maintenance_test(params, num_streams, 1)
+    _run_phase(
+        state, "maintenance_test_1", dm_cfg.get("skip"),
+        lambda: maintenance_test(params, num_streams, 1),
+    )
     tdm1 = get_maintenance_time(
         dm_cfg["maintenance_report_base_path"], num_streams, 1
     )
-    if not tt_cfg.get("skip"):
-        throughput_test(params, num_streams, 2)
+    _run_phase(
+        state, "throughput_test_2", tt_cfg.get("skip"),
+        lambda: throughput_test(params, num_streams, 2),
+    )
     ttt2 = get_throughput_time(tt_cfg["report_base_path"], num_streams, 2)
-    if not dm_cfg.get("skip"):
-        maintenance_test(params, num_streams, 2)
+    _run_phase(
+        state, "maintenance_test_2", dm_cfg.get("skip"),
+        lambda: maintenance_test(params, num_streams, 2),
+    )
     tdm2 = get_maintenance_time(
         dm_cfg["maintenance_report_base_path"], num_streams, 2
     )

@@ -6,12 +6,35 @@ the extra time log *via Spark* precisely so it can land on cloud storage).
 This module is the equivalent seam: any `scheme://` path is handled by the
 matching fsspec filesystem (memory:// in tests, s3://gs://abfs:// in real
 deployments), plain paths stay on the fast local-POSIX code paths.
+
+Failure domain: remote opens retry transient errors with exponential
+backoff + jitter (NDS_IO_RETRIES / NDS_IO_BACKOFF — object stores throttle
+and reset connections routinely, and one 503 must not kill a benchmark
+phase), `fs_open_atomic` writes via a temp name + rename so a crash mid-write
+can never leave a torn report/manifest behind, and every open is a fault
+injection point (faults.maybe_fire_path) so those paths are testable.
 """
 
 from __future__ import annotations
 
 import os
 import posixpath
+import time
+import uuid
+
+from .. import faults
+
+#: default transient-IO retry budget for remote opens (attempts = retries+1)
+IO_RETRIES_ENV = "NDS_IO_RETRIES"
+IO_BACKOFF_ENV = "NDS_IO_BACKOFF"
+
+
+def io_retry_budget():
+    """(retries, backoff_base_seconds) for transient remote-IO failures."""
+    return (
+        int(os.environ.get(IO_RETRIES_ENV, "3")),
+        float(os.environ.get(IO_BACKOFF_ENV, "0.5")),
+    )
 
 
 def is_remote(path) -> bool:
@@ -29,22 +52,117 @@ def get_fs(path):
     return fs, paths[0]
 
 
+def _open_remote_with_retries(path, mode):
+    """Open a remote path, retrying transient failures with exponential
+    backoff + full jitter. Deterministic errors raise immediately."""
+    retries, base = io_retry_budget()
+    delays = faults.backoff_delays(retries, base)
+    while True:
+        try:
+            faults.maybe_fire_path(path)
+            fs, p = get_fs(path)
+            if "w" in mode or "a" in mode:
+                parent = posixpath.dirname(p)
+                if parent:
+                    fs.makedirs(parent, exist_ok=True)
+            return fs.open(p, mode)
+        except Exception as exc:
+            if faults.classify(exc) != faults.IO_TRANSIENT:
+                raise
+            delay = next(delays, None)
+            if delay is None:
+                raise
+            print(
+                f"fs: transient io failure opening {path} ({exc}); "
+                f"retrying in {delay:.2f}s"
+            )
+            time.sleep(delay)
+
+
 def fs_open(path, mode: str = "r", newline=None, encoding=None):
     """open() for local paths and URLs alike (caller closes). `newline`
     and `encoding` apply to local text mode (csv writers need
     newline=''); fsspec text mode already uses newline=''."""
     if not is_remote(path):
+        faults.maybe_fire_path(path)
         if "w" in mode or "a" in mode:
             parent = os.path.dirname(str(path))
             if parent:
                 os.makedirs(parent, exist_ok=True)
         return open(path, mode, newline=newline, encoding=encoding)
-    fs, p = get_fs(path)
-    if "w" in mode or "a" in mode:
-        parent = posixpath.dirname(p)
-        if parent:
-            fs.makedirs(parent, exist_ok=True)
-    return fs.open(p, mode)
+    return _open_remote_with_retries(path, mode)
+
+
+class _AtomicFile:
+    """File-like wrapper that writes to a temp sibling and renames into
+    place on a clean close; close-after-error (or interpreter teardown mid-
+    write) leaves the destination untouched — readers see the old complete
+    file or the new complete file, never a torn one."""
+
+    def __init__(self, path, mode, newline=None, encoding=None):
+        self._dest = str(path)
+        self._remote = is_remote(path)
+        suffix = f".tmp-{uuid.uuid4().hex[:8]}"
+        if self._remote:
+            self._tmp = self._dest + suffix
+            self._fh = fs_open(self._tmp, mode)
+        else:
+            parent = os.path.dirname(self._dest)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            self._tmp = self._dest + suffix
+            faults.maybe_fire_path(self._dest)
+            self._fh = open(self._tmp, mode, newline=newline, encoding=encoding)
+        self._committed = False
+
+    def __getattr__(self, name):
+        return getattr(self._fh, name)
+
+    def __iter__(self):
+        return iter(self._fh)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close(commit=exc_type is None)
+        return False
+
+    def close(self, commit: bool = True):
+        if self._committed:
+            return
+        self._fh.close()
+        if not commit:
+            self._discard()
+            return
+        self._committed = True
+        if self._remote:
+            fs, tmp = get_fs(self._tmp)
+            _, dest = get_fs(self._dest)
+            fs.mv(tmp, dest)
+        else:
+            os.replace(self._tmp, self._dest)
+
+    def _discard(self):
+        self._committed = True
+        try:
+            if self._remote:
+                fs, tmp = get_fs(self._tmp)
+                fs.rm_file(tmp)
+            else:
+                os.unlink(self._tmp)
+        except OSError:
+            pass
+
+
+def fs_open_atomic(path, mode: str = "w", newline=None, encoding=None):
+    """Crash-safe fs_open for whole-file writes (reports, time logs, state
+    files): content lands under a temp name and renames into place on close.
+    Use as a context manager; an exception inside the block discards the
+    temp file instead of publishing it."""
+    if "w" not in mode:
+        raise ValueError(f"fs_open_atomic is write-only, got mode {mode!r}")
+    return _AtomicFile(path, mode, newline=newline, encoding=encoding)
 
 
 def join(base, *parts) -> str:

@@ -168,6 +168,15 @@ class LakehouseTable:
 
     def _commit(self, staged, operation, base_files=None, num_rows=None, schema=None):
         """Append the next manifest: base file list + staged files."""
+        from .. import faults
+
+        if faults.active():
+            # failure-domain injection site: a fault here lands BEFORE the
+            # manifest publish, so staged data files exist but no snapshot
+            # references them — proving commits are all-or-nothing under
+            # io/crash faults (Iceberg's commit-point guarantee)
+            faults.maybe_fire(f"commit:{posixpath.basename(self.root)}")
+            faults.maybe_fire_path(self.root)
         schema_hex = None
         if schema is not None:
             schema_hex = bytes(schema.serialize()).hex()

@@ -17,6 +17,7 @@ from datetime import datetime
 
 from .check import check_json_summary_folder
 from .engine.session import Session
+from .io.fs import fs_open_atomic
 from .power import load_properties
 from .report import BenchReport
 from .schema import get_maintenance_schemas, get_schemas
@@ -193,7 +194,11 @@ def run_maintenance(
     execution_time_list.append((app_id, "Total Time", total_elapse))
 
     header = ["application_id", "query", "time/s"]
-    with open(time_log_output_path, "w", encoding="UTF8", newline="") as f:
+    # atomic: full_bench resume re-parses this log for Tdm, so a crash
+    # mid-write must never leave a torn CSV behind
+    with fs_open_atomic(
+        time_log_output_path, "w", encoding="UTF8", newline=""
+    ) as f:
         writer = csv.writer(f)
         writer.writerow(header)
         writer.writerows(execution_time_list)

@@ -14,8 +14,9 @@ import os
 import time
 from collections import OrderedDict
 
+from . import faults
 from .check import check_json_summary_folder, check_query_subset_exists
-from .io.fs import fs_open
+from .io.fs import fs_open, fs_open_atomic
 from .datagen.query_streams import split_special_query
 from .engine.session import Session
 from .report import BenchReport
@@ -31,8 +32,17 @@ def gen_sql_from_stream(query_stream_file_path: str) -> "OrderedDict[str, str]":
     queries = OrderedDict()
     for q in stream.split("-- start")[1:]:
         name = q[q.find("template") + 9 : q.find(".tpl")]
+        parts = q.split(";")
+        if len(parts) < 2:
+            # a stream entry with no statement terminator would otherwise
+            # surface as a bare IndexError from deep inside the split
+            raise ValueError(
+                f"malformed stream file {query_stream_file_path}: entry "
+                f"{name or q.splitlines()[0].strip()!r} has no ';'-terminated "
+                f"statement"
+            )
         # a second statement before the end marker => two-part template
-        if "select" in q.split(";")[1]:
+        if "select" in parts[1]:
             part_1, part_2 = split_special_query(q)
             queries[name + "_part1"] = "-- start" + part_1
             queries[name + "_part2"] = "-- start" + part_2
@@ -102,14 +112,19 @@ def ensure_valid_column_names(arrow_table):
 def run_one_query(session, query, query_name, output_path, output_format):
     """Execute one stream entry; collect to host, or write for validation
     (reference: nds/nds_power.py:125-135)."""
-    result = session.run_script(query)
-    if result is None:
-        return
-    if not output_path:
-        result.collect()
-    else:
-        dest = os.path.join(output_path, query_name)
-        result.write(dest, output_format, transform=ensure_valid_column_names)
+    with faults.scope(query_name):
+        # primary per-query injection site (oom:<query>/hang:<query>/...);
+        # sits inside the BenchReport attempt so injected faults walk the
+        # same classification + ladder a real failure would
+        faults.maybe_fire(query_name)
+        result = session.run_script(query)
+        if result is None:
+            return
+        if not output_path:
+            result.collect()
+        else:
+            dest = os.path.join(output_path, query_name)
+            result.write(dest, output_format, transform=ensure_valid_column_names)
 
 
 def load_properties(filename: str) -> dict:
@@ -139,6 +154,7 @@ def run_query_stream(
     keep_session=False,
     mesh_devices=None,
     start_gate=None,
+    query_timeout=None,
 ):
     """Run the stream sequentially with per-query timing and reports.
 
@@ -155,6 +171,11 @@ def run_query_stream(
     conf = {"app.name": app_name}
     if property_file:
         conf.update(load_properties(property_file))
+    if query_timeout is not None:
+        # CLI tier wins over property file (an explicit 0 DISABLES a
+        # property-file watchdog); BenchReport reads this conf key
+        # (falling back to NDS_QUERY_TIMEOUT) for its watchdog budget
+        conf["engine.query_timeout"] = query_timeout
     check_json_summary_folder(json_summary_folder)
     mesh = None
     if mesh_devices:
@@ -212,14 +233,16 @@ def run_query_stream(
     for row in execution_time_list:
         print(row)
     if time_log_output_path:
-        with fs_open(time_log_output_path, "w", encoding="UTF8", newline="") as f:
+        # atomic: full_bench resume re-parses this log, so a crash mid-write
+        # must leave either no log or a complete one, never a torn file
+        with fs_open_atomic(time_log_output_path, "w", encoding="UTF8", newline="") as f:
             writer = csv.writer(f)
             writer.writerow(header)
             writer.writerows(execution_time_list)
     if extra_time_log_output_path:
         # reference writes this via Spark so it can land on cloud storage;
         # our IO layer is fs-agnostic, a plain copy keeps the contract
-        with fs_open(extra_time_log_output_path, "w", encoding="UTF8", newline="") as f:
+        with fs_open_atomic(extra_time_log_output_path, "w", encoding="UTF8", newline="") as f:
             writer = csv.writer(f)
             writer.writerow(header)
             writer.writerows(execution_time_list)

@@ -9,6 +9,15 @@ inside the executor (e.g. a partition-exchange capacity retry on the mesh)
 are fanned out to listeners registered on the Session, and a query that
 completed despite such incidents is reported `CompletedWithTaskFailures`.
 
+Failure domain: a failed attempt is classified (faults.classify) and walked
+down a degradation ladder instead of the reference's single implicit task
+retry — device OOM gets recover+retry then a shrunken blocked-union window,
+transient IO gets backoff retries, a hung query is cut off by the watchdog
+(`engine.query_timeout` / NDS_QUERY_TIMEOUT) and recorded as a `timeout`
+failure instead of stalling the stream. Every attempt's error lands in
+`exceptions`, the rungs walked land in `ladder`, and a terminal failure
+carries `failureKind`.
+
 The summary field set and the `<prefix>-<query>-<startTime>.json` filename
 contract are kept identical so downstream report tooling ports unchanged.
 """
@@ -17,16 +26,27 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Callable
 
 import jax
 
-from .io.fs import fs_open
+from . import faults
+from .io.fs import fs_open_atomic, io_retry_budget
 
 from . import __version__
 
 _REDACTED = ("TOKEN", "SECRET", "PASSWORD", "PASSWD", "CREDENTIAL", "KEY")
+
+#: marker embedded in watchdog-generated error text; classify() maps it to
+#: faults.TIMEOUT (keep in sync with faults._TIMEOUT_PAT)
+_WATCHDOG_MARK = "query watchdog"
+
+#: shrunken blocked-union window (rows) the last ladder rung forces when a
+#: query keeps OOMing — small enough to relieve HBM pressure on any plan
+#: that routes through the blocked-union path, large enough to make progress
+_DEGRADED_WINDOW_ROWS = 1 << 18
 
 
 def engine_conf(session) -> dict:
@@ -41,6 +61,18 @@ def engine_conf(session) -> dict:
     }
     conf.update(getattr(session, "conf", {}) or {})
     return {k: str(v) for k, v in conf.items()}
+
+
+def query_timeout(session) -> float:
+    """Per-query watchdog budget in seconds; 0 disables (the default).
+    Conf `engine.query_timeout` wins over the NDS_QUERY_TIMEOUT env knob."""
+    v = getattr(session, "conf", {}).get("engine.query_timeout") or os.environ.get(
+        "NDS_QUERY_TIMEOUT"
+    )
+    try:
+        return max(float(v), 0.0) if v else 0.0
+    except (TypeError, ValueError):
+        return 0.0
 
 
 class BenchReport:
@@ -58,13 +90,121 @@ class BenchReport:
             "exceptions": [],
             "startTime": None,
             "queryTimes": [],
+            "retries": 0,
         }
+
+    # ------------------------------------------------------------------
+    # single attempt, optionally under the watchdog
+    # ------------------------------------------------------------------
+    def _attempt(self, fn, args, timeout: float):
+        """Run fn(*args); return None on success or the error text.
+
+        The error is returned as TEXT, without holding the exception (a
+        live traceback would pin the failed attempt's multi-GB device
+        intermediates through any recovery/retry). With a timeout budget
+        the attempt runs on a daemon worker thread: if the budget expires
+        the worker is abandoned (it holds no locks the stream needs) and
+        the query becomes a classified `timeout` failure instead of
+        stalling the whole stream's Ttt window."""
+
+        def _call():
+            try:
+                fn(*args)
+                return None
+            except faults.InjectedCrash:
+                raise
+            except Exception as e:
+                msg = str(e)
+                return f"{type(e).__name__}: {msg}" if msg else type(e).__name__
+
+        if timeout <= 0:
+            return _call()
+        box = {}
+        done = threading.Event()
+
+        def _worker():
+            try:
+                box["err"] = _call()
+            except BaseException as e:  # InjectedCrash: re-raise on caller
+                box["crash"] = e
+            finally:
+                done.set()
+
+        t = threading.Thread(
+            target=_worker, name="nds-query-watchdog-worker", daemon=True
+        )
+        t.start()
+        if not done.wait(timeout):
+            return (
+                f"{_WATCHDOG_MARK}: query exceeded the {timeout:.1f}s budget "
+                f"(engine.query_timeout / NDS_QUERY_TIMEOUT); worker abandoned"
+            )
+        if "crash" in box:
+            raise box["crash"]
+        return box.get("err")
+
+    # ------------------------------------------------------------------
+    # degradation ladder
+    # ------------------------------------------------------------------
+    def _next_rung(self, kind: str, rungs_taken, can_retry: bool):
+        """The next recovery rung for a failure of `kind`, or None.
+
+        device_oom: recover_memory+retry, then shrink the blocked-union
+        window (PR-1) and retry on a clean device; host_oom: recover+retry
+        once; io_transient: up to NDS_IO_RETRIES backoff retries; timeout/
+        planner/data/unknown: deterministic or likely-to-repeat — fail fast."""
+        if not can_retry:
+            return None
+        taken = [r["rung"] for r in rungs_taken]
+        if kind == faults.DEVICE_OOM:
+            if "recover_retry" not in taken:
+                return "recover_retry"
+            if "shrink_union_window" not in taken:
+                return "shrink_union_window"
+            return None
+        if kind == faults.HOST_OOM:
+            return "recover_retry" if "recover_retry" not in taken else None
+        if kind == faults.IO_TRANSIENT:
+            retries, _ = io_retry_budget()
+            if sum(1 for r in taken if r == "io_backoff_retry") < retries:
+                return "io_backoff_retry"
+            return None
+        return None
+
+    def _apply_rung(self, rung: str, kind: str, io_attempt: int):
+        session = self.session
+        if rung in ("recover_retry", "shrink_union_window"):
+            if hasattr(session, "recover_memory"):
+                session.recover_memory(
+                    "device memory exhausted"
+                    if kind == faults.DEVICE_OOM
+                    else "host memory exhausted"
+                )
+        if rung == "shrink_union_window":
+            # degrade persistently: halve an explicit window, else force a
+            # small one — every later query in this stream's session then
+            # routes blocked-union plans through bounded windows too
+            conf = getattr(session, "conf", None)
+            if conf is not None:
+                cur = conf.get("engine.union_agg_window_rows")
+                new = max(int(cur) // 2, 4096) if cur else _DEGRADED_WINDOW_ROWS
+                conf["engine.union_agg_window_rows"] = new
+                return {"window_rows": new}
+        if rung == "io_backoff_retry":
+            _, base = io_retry_budget()
+            delay = next(faults.backoff_delays(1, base * (2 ** io_attempt)), 0.0)
+            if delay:
+                time.sleep(delay)
+            return {"delay_s": round(delay, 3)}
+        return None
 
     def report_on(self, fn: Callable, *args, retry_oom: bool = False):
         """Run fn(*args), recording env (secrets redacted), status and time.
 
-        retry_oom: retry ONCE after device-memory exhaustion (caller must
-        guarantee fn is idempotent — read-only queries yes, DML no)."""
+        retry_oom: allow the retrying ladder rungs (caller must guarantee
+        fn is idempotent — read-only queries yes, DML no). Non-idempotent
+        callables still get classification, the watchdog, and full attempt
+        records; they just never re-run."""
         env_vars = {
             k: v
             for k, v in os.environ.items()
@@ -80,48 +220,53 @@ class BenchReport:
             registered = True
         except AttributeError:
             pass
+        timeout = query_timeout(self.session)
         start_time = int(time.time() * 1000)
-
-        def _attempt():
-            # returns the error text, WITHOUT holding the exception (a live
-            # traceback would pin the failed attempt's multi-GB device
-            # intermediates through any recovery/retry)
-            try:
-                fn(*args)
-                return None
-            except Exception as e:
-                return str(e) or type(e).__name__
-
+        rungs: list[dict] = []
+        attempt_errors: list[str] = []
         try:
-            err = _attempt()
-            if (
-                err is not None
-                and "RESOURCE_EXHAUSTED" in err
-                and hasattr(self.session, "recover_memory")
-            ):
-                # device memory exhaustion mid-execution: drop every
-                # recoverable allocation; retry once on the clean device
-                # when fn is idempotent — without the recovery, one OOM
-                # poisons the whole remaining stream (reference analogue:
-                # executor loss -> task retry on a fresh executor)
-                self.session.recover_memory("device memory exhausted")
-                if retry_oom:
-                    err = _attempt()
-                    if err is not None and "RESOURCE_EXHAUSTED" in err:
-                        self.session.recover_memory("device memory exhausted")
+            err = self._attempt(fn, args, timeout)
+            while err is not None:
+                attempt_errors.append(err)
+                kind = faults.classify(err)
+                rung = self._next_rung(kind, rungs, can_retry=retry_oom)
+                if rung is None:
+                    break
+                io_retries_so_far = sum(
+                    1 for r in rungs if r["rung"] == "io_backoff_retry"
+                )
+                detail = self._apply_rung(rung, kind, io_retries_so_far)
+                entry = {"rung": rung, "kind": kind}
+                if detail:
+                    entry.update(detail)
+                rungs.append(entry)
+                err = self._attempt(fn, args, timeout)
+            if err is not None and faults.classify(err) == faults.DEVICE_OOM:
+                # terminal OOM: drop caches once more so the failure cannot
+                # poison the remaining stream (reference analogue: executor
+                # replaced after repeated task failure)
+                if hasattr(self.session, "recover_memory"):
+                    self.session.recover_memory("device memory exhausted")
         finally:
             if registered:
                 self.session.unregister_listener(failures.append)
         end_time = int(time.time() * 1000)
+        self.summary["retries"] = len(rungs)
+        if rungs:
+            self.summary["ladder"] = rungs
         if err is None:
-            if failures:
+            if attempt_errors:
+                # recovered by the ladder: record what it took
+                self.summary["exceptions"].extend(attempt_errors)
+            if failures or attempt_errors:
                 self.summary["queryStatus"].append("CompletedWithTaskFailures")
             else:
                 self.summary["queryStatus"].append("Completed")
         else:  # a failed query must not abort the stream
             print(err)
             self.summary["queryStatus"].append("Failed")
-            self.summary["exceptions"].append(err)
+            self.summary["exceptions"].extend(attempt_errors)
+            self.summary["failureKind"] = faults.classify(err)
         self.summary["startTime"] = start_time
         self.summary["queryTimes"].append(end_time - start_time)
         if failures:
@@ -130,10 +275,12 @@ class BenchReport:
 
     def write_summary(self, query_name: str, prefix: str = "") -> str:
         """Write `<prefix>-<query>-<startTime>.json` (reference keeps this
-        exact name format for its Power-BI pipeline; we keep it for parity)."""
+        exact name format for its Power-BI pipeline; we keep it for parity).
+        The write is atomic (temp name + rename) so a crash mid-dump can't
+        leave a torn JSON that later report parsing chokes on."""
         self.summary["query"] = query_name
         filename = f"{prefix}-{query_name}-{self.summary['startTime']}.json"
         self.summary["filename"] = filename
-        with fs_open(filename, "w") as f:
+        with fs_open_atomic(filename, "w") as f:
             json.dump(self.summary, f, indent=2)
         return filename

@@ -114,6 +114,7 @@ def run_throughput(
     mode="thread",
     sub_queries=None,
     gate_timeout=None,
+    query_timeout=None,
 ):
     """Run the streams in `stream_paths` ({stream_num: stream_file})
     concurrently; write `<time_log_base>_<n>.csv` per stream; return Ttt
@@ -129,7 +130,7 @@ def run_throughput(
         return _run_throughput_processes(
             input_prefix, stream_paths, time_log_base, input_format,
             use_decimal, property_file, json_summary_folder, output_path,
-            output_format, sub_queries,
+            output_format, sub_queries, query_timeout,
         )
     errors = {}
     # All streams rendezvous after table setup, before their Power clocks
@@ -167,6 +168,7 @@ def run_throughput(
                 ),
                 output_format=output_format,
                 start_gate=gate.wait,
+                query_timeout=query_timeout,
             )
         except Exception as exc:
             errors[n] = exc
@@ -206,51 +208,97 @@ def _ttt_from_logs(streams, time_log_base) -> float:
     return max(round_up_to_nearest_10_percent(max(ends) - min(starts)), 0.1)
 
 
+def stream_wait_budget(query_timeout=None, n_queries: int = 103):
+    """Per-child wall-clock budget (seconds) for process-mode streams, or
+    None for unbounded. NDS_STREAM_TIMEOUT wins; else it derives from the
+    per-query watchdog budget (engine-side NDS_QUERY_TIMEOUT) times a full
+    stream's statement count plus setup slack — a child that blows through
+    every per-query watchdog AND this outer budget is declared hung."""
+    v = os.environ.get("NDS_STREAM_TIMEOUT")
+    if v:
+        return float(v) or None
+    qt = query_timeout or os.environ.get("NDS_QUERY_TIMEOUT")
+    if qt:
+        return float(qt) * n_queries + 600
+    return None
+
+
 def _run_throughput_processes(
     input_prefix, stream_paths, time_log_base, input_format, use_decimal,
     property_file, json_summary_folder, output_path, output_format,
-    sub_queries=None,
+    sub_queries=None, query_timeout=None,
 ):
     """One `nds_tpu.cli.power` subprocess per stream, all concurrent."""
     import subprocess
     import sys
 
     procs = {}
-    for n, path in sorted(stream_paths.items()):
-        cmd = [
-            sys.executable, "-m", "nds_tpu.cli.power",
-            input_prefix, path, f"{time_log_base}_{n}.csv",
-            "--input_format", input_format,
-            "--output_format", output_format,
-        ]
-        if not use_decimal:
-            cmd.append("--floats")
-        if property_file:
-            cmd += ["--property_file", property_file]
-        if json_summary_folder:
-            cmd += [
-                "--json_summary_folder",
-                os.path.join(json_summary_folder, f"stream_{n}"),
-            ]
-        if output_path:
-            cmd += ["--output_prefix", f"{output_path}_{n}"]
-        if sub_queries:
-            cmd += ["--sub_queries", ",".join(sub_queries)]
-        # each child logs to its own file: a shared PIPE read sequentially
-        # would block a chatty stream on pipe backpressure mid-benchmark,
-        # stretching its time window and corrupting Ttt
-        logf = open(f"{time_log_base}_{n}.out", "w")
-        procs[n] = (
-            subprocess.Popen(cmd, stdout=logf, stderr=subprocess.STDOUT),
-            logf,
-        )
     failures = {}
-    for n, (p, logf) in procs.items():
-        p.wait()
-        logf.close()
-        if p.returncode != 0:
-            with open(f"{time_log_base}_{n}.out") as f:
-                failures[n] = f.read()[-2000:]
+    try:
+        for n, path in sorted(stream_paths.items()):
+            cmd = [
+                sys.executable, "-m", "nds_tpu.cli.power",
+                input_prefix, path, f"{time_log_base}_{n}.csv",
+                "--input_format", input_format,
+                "--output_format", output_format,
+            ]
+            if not use_decimal:
+                cmd.append("--floats")
+            if property_file:
+                cmd += ["--property_file", property_file]
+            if query_timeout:
+                cmd += ["--query_timeout", str(query_timeout)]
+            if json_summary_folder:
+                cmd += [
+                    "--json_summary_folder",
+                    os.path.join(json_summary_folder, f"stream_{n}"),
+                ]
+            if output_path:
+                cmd += ["--output_prefix", f"{output_path}_{n}"]
+            if sub_queries:
+                cmd += ["--sub_queries", ",".join(sub_queries)]
+            # each child logs to its own file: a shared PIPE read
+            # sequentially would block a chatty stream on pipe backpressure
+            # mid-benchmark, stretching its time window and corrupting Ttt
+            logf = open(f"{time_log_base}_{n}.out", "w")
+            try:
+                p = subprocess.Popen(
+                    cmd, stdout=logf, stderr=subprocess.STDOUT
+                )
+            except BaseException:
+                logf.close()
+                raise
+            procs[n] = (p, logf)
+        budget = stream_wait_budget(
+            query_timeout, len(sub_queries) if sub_queries else 103
+        )
+        for n, (p, logf) in procs.items():
+            try:
+                p.wait(timeout=budget)
+            except subprocess.TimeoutExpired:
+                # the watchdog budget is exhausted: a hung child must not
+                # stall the whole Throughput Test forever
+                p.kill()
+                p.wait()
+                failures[n] = (
+                    f"stream {n} exceeded the {budget:.0f}s watchdog "
+                    f"budget (NDS_STREAM_TIMEOUT / NDS_QUERY_TIMEOUT) "
+                    f"and was killed"
+                )
+                continue
+            finally:
+                logf.close()
+            if p.returncode != 0:
+                with open(f"{time_log_base}_{n}.out") as f:
+                    failures[n] = f.read()[-2000:]
+    finally:
+        # a Popen failure (or any error above) must not leak children or
+        # their log handles
+        for n, (p, logf) in procs.items():
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+            logf.close()
     if failures:
         raise RuntimeError(f"throughput stream processes failed: {failures}")
     return _ttt_from_logs(stream_paths, time_log_base)
