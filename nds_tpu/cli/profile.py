@@ -1,0 +1,171 @@
+"""Operator-level query profiler over the engine's structured event logs
+(the local analogue of the reference's RAPIDS profiling tool over Spark
+event logs).
+
+    python -m nds_tpu.cli.profile <events.jsonl | trace_dir>...
+        [--top N] [--per_query] [--json] [--check]
+    python -m nds_tpu.cli.profile --compare OLD NEW
+        [--ratio 1.25] [--min_ms 50] [--fail_on_regression]
+
+Single-run mode aggregates one or more event logs (files or trace dirs —
+a throughput run's per-stream files profile together naturally) into
+per-query operator time/rows breakdowns, the top-N hottest operators
+across the run, and cache-hit/retry tallies. `--compare` diffs two runs
+and flags per-query and per-operator regressions. Exit codes: 0 ok,
+1 regressions found under --fail_on_regression, 2 malformed event log.
+"""
+
+import argparse
+import json
+import sys
+
+from ..obs import reader as R
+
+
+def _fmt_bytes(n):
+    if n is None:
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024
+
+
+def _fmt_ms(v):
+    return "-" if v is None else f"{v:,.1f}"
+
+
+def _load(paths, check: bool):
+    try:
+        events = R.read_events(paths, strict=True)
+    except (R.MalformedEventError, OSError) as exc:
+        print(f"profile: {exc}", file=sys.stderr)
+        sys.exit(2)
+    problems = R.validate_events(events)
+    if problems:
+        for p in problems[:20]:
+            print(f"profile: schema: {p}", file=sys.stderr)
+        if check:
+            sys.exit(2)
+    return events
+
+
+def _render_profile(prof, top: int, per_query: bool):
+    queries = prof["queries"]
+    n_failed = sum(
+        1 for v in queries.values() if v.get("status") == "Failed"
+    )
+    print(f"== {len(queries)} queries ({n_failed} failed)")
+    for q in sorted(queries):
+        rec = queries[q]
+        mem = ""
+        if rec.get("mem_hw_bytes") is not None:
+            mem = (f"  mem_hw {_fmt_bytes(rec['mem_hw_bytes'])}"
+                   f" ({rec.get('mem_source')})")
+        status = rec.get("status") or "?"
+        if rec.get("failure_kind"):
+            status += f" ({rec['failure_kind']})"
+        runs = f" x{rec['runs']}" if rec.get("runs", 1) > 1 else ""
+        print(f"\n-- {q}{runs}: wall {_fmt_ms(rec.get('wall_ms'))} ms  "
+              f"plan {_fmt_ms(rec.get('root_incl_ms'))} ms  {status}{mem}")
+        if per_query and rec["ops"]:
+            print(f"   {'operator':<18}{'count':>6}{'incl_ms':>12}"
+                  f"{'excl_ms':>12}{'rows':>12}")
+            for node, op in sorted(
+                rec["ops"].items(), key=lambda kv: -kv[1]["excl_ms"]
+            ):
+                print(f"   {node:<18}{op['count']:>6}"
+                      f"{op['incl_ms']:>12,.1f}{op['excl_ms']:>12,.1f}"
+                      f"{op['rows']:>12,}")
+    hot = sorted(
+        prof["op_totals"].items(), key=lambda kv: -kv[1]["excl_ms"]
+    )[:top]
+    if hot:
+        print(f"\n== top {len(hot)} operators by exclusive time (run-wide)")
+        print(f"   {'operator':<18}{'count':>6}{'incl_ms':>12}"
+              f"{'excl_ms':>12}{'rows':>12}")
+        for node, op in hot:
+            print(f"   {node:<18}{op['count']:>6}{op['incl_ms']:>12,.1f}"
+                  f"{op['excl_ms']:>12,.1f}{op['rows']:>12,}")
+    t = prof["tallies"]
+    print(f"\n== tallies: plan-cache {t['plan_cache_hits']} hit / "
+          f"{t['plan_cache_misses']} miss; catalog {t['catalog_loads']} "
+          f"loads ({t['catalog_cache_hits']} cache-hit); "
+          f"io retries {t['io_retries']}; ladder rungs {t['ladder_rungs']}; "
+          f"watchdog fires {t['watchdog_fires']}; faults injected "
+          f"{t['faults_injected']}; blocked-union windows "
+          f"{t['blocked_union_windows']}")
+
+
+def _render_compare(regs, ratio, min_ms):
+    if not regs:
+        print(f"== no regressions (threshold: {ratio:.2f}x and "
+              f">= {min_ms:.0f} ms)")
+        return
+    print(f"== {len(regs)} regression(s) (threshold: {ratio:.2f}x and "
+          f">= {min_ms:.0f} ms)")
+    for r in regs:
+        if r["change"] == "status_change":
+            print(f"   {r['query']}: {r['detail']}")
+        elif r["level"] == "query":
+            print(f"   {r['query']}: wall {r['old_ms']:,.1f} -> "
+                  f"{r['new_ms']:,.1f} ms ({r['ratio']:.2f}x)")
+        else:
+            print(f"   {r['query']}/{r['node']}: excl {r['old_ms']:,.1f} -> "
+                  f"{r['new_ms']:,.1f} ms ({r['ratio']:.2f}x)")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="aggregate nds-tpu event logs into operator-level "
+        "profiles; compare two runs for regressions"
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="event-log files or trace directories (events-*.jsonl)",
+    )
+    parser.add_argument(
+        "--compare", nargs=2, metavar=("OLD", "NEW"),
+        help="A/B mode: two event logs / trace dirs to diff",
+    )
+    parser.add_argument("--top", type=int, default=10,
+                        help="top-N hottest operators (10)")
+    parser.add_argument("--per_query", action="store_true",
+                        help="print the per-operator table for every query")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit the aggregate as JSON instead of text")
+    parser.add_argument("--check", action="store_true",
+                        help="exit 2 on any schema problem (CI gate); "
+                        "malformed JSON lines always exit 2")
+    parser.add_argument("--ratio", type=float, default=1.25,
+                        help="compare: flag when new >= old * ratio (1.25)")
+    parser.add_argument("--min_ms", type=float, default=50.0,
+                        help="compare: minimum absolute delta in ms (50)")
+    parser.add_argument("--fail_on_regression", action="store_true",
+                        help="compare: exit 1 when regressions are flagged")
+    args = parser.parse_args(argv)
+
+    if args.compare:
+        old_prof = R.profile_events(_load([args.compare[0]], args.check))
+        new_prof = R.profile_events(_load([args.compare[1]], args.check))
+        regs = R.compare_profiles(
+            old_prof, new_prof, ratio=args.ratio, min_ms=args.min_ms
+        )
+        if args.as_json:
+            print(json.dumps({"regressions": regs}, indent=2))
+        else:
+            _render_compare(regs, args.ratio, args.min_ms)
+        if regs and args.fail_on_regression:
+            sys.exit(1)
+        return
+    if not args.paths:
+        parser.error("give event-log paths, or --compare OLD NEW")
+    prof = R.profile_events(_load(args.paths, args.check))
+    if args.as_json:
+        print(json.dumps(prof, indent=2))
+    else:
+        _render_profile(prof, args.top, args.per_query)
+
+
+if __name__ == "__main__":
+    main()

@@ -220,6 +220,19 @@ class Table:
         return self._packed
 
 
+def table_device_bytes(table: Table) -> int:
+    """Device bytes held by a table's buffers (data + validity masks;
+    capacity-padded shapes are static, so this never syncs the device).
+    THE byte-estimation rule: the session plan-cache budget and the obs
+    op_span `est_bytes` field both read it, so they cannot drift."""
+    total = 0
+    for c in table.columns.values():
+        total += int(c.data.nbytes)
+        if c.valid is not None:
+            total += int(c.valid.nbytes)
+    return total
+
+
 # ---------------------------------------------------------------------------
 # Bounded row windows (blocked union-aggregation)
 # ---------------------------------------------------------------------------

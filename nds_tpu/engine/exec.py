@@ -12,12 +12,15 @@ rapids plugin (reference: nds/nds_power.py:125-135 spark.sql -> collect).
 
 from __future__ import annotations
 
+import itertools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pyarrow as pa
 
 from dataclasses import replace as _dc_replace
+from time import perf_counter as _perf
 
 from ..dtypes import BOOL, DType, FLOAT64, INT64
 from ..ops import kernels as K
@@ -30,6 +33,7 @@ from .columnar import (
     table_to_arrow,
     unify_dictionaries,
     sort_dictionary,
+    table_device_bytes,
     window_slice,
 )
 from .expr import Evaluator, _and_valid, _cast_column
@@ -39,9 +43,9 @@ class ExecError(Exception):
     pass
 
 
-# dev tracing: set to a list to record (inclusive_seconds, node_type,
-# summary) per executed plan node (used by tools/trace_query.py)
-TRACE_NODES = None
+# executor instance ids for op-span grouping in the event log (profiling
+# reconstructs span nesting per (query, executor) from seq/depth)
+_EXEC_IDS = itertools.count(1)
 
 
 def _resolve_bounds(datas, valids, stats_list, wanted, live):
@@ -173,13 +177,21 @@ class _DictStats:
 
 
 class Executor:
-    def __init__(self, catalog, on_task_failure=None):
+    def __init__(self, catalog, on_task_failure=None, tracer=None):
         """catalog: object with .load(table_name) -> Table.
 
         on_task_failure(reason) is called for recoverable incidents the
         executor survives (capacity-overflow retries, fallbacks) so the
         harness can report CompletedWithTaskFailures (reference analogue:
-        Spark task retries surfaced via jvm_listener)."""
+        Spark task retries surfaced via jvm_listener).
+
+        tracer: an obs.Tracer (defaults to the owning session's) — every
+        executed plan node then records an `op_span` event with inclusive
+        wall time, output rows, and estimated output bytes. Per-executor
+        span state (exec id, seq, depth) is thread-safe by construction:
+        each concurrent throughput stream builds its own Executor per
+        statement, so streams never share span collections (the old
+        module-global TRACE_NODES would have corrupted across streams)."""
         self.catalog = catalog
         self.on_task_failure = on_task_failure or (lambda reason: None)
         self._cte_cache = {}  # id(plan) -> Table
@@ -188,6 +200,14 @@ class Executor:
         # stats of the most recent blocked union-aggregation (tests/tools)
         self.last_blocked_union = None
         self._fault_checked = False  # exec-root injection fires once
+        if tracer is None:
+            tracer = getattr(
+                getattr(catalog, "session", None), "tracer", None
+            )
+        self.tracer = tracer
+        self._span_depth = 0
+        self._span_seq = 0
+        self._exec_id = next(_EXEC_IDS) if tracer is not None else 0
 
     # plan-node types worth caching across statements: the expensive
     # pipeline breakers (a CTE body virtually always ends in one)
@@ -226,6 +246,7 @@ class Executor:
         key = id(node)
         if key in self._cte_cache:
             return self._cte_cache[key]
+        tracer = self.tracer
         cache = (
             self._session_cache()
             if isinstance(node, self._CACHEABLE)
@@ -233,21 +254,42 @@ class Executor:
         )
         if cache is not None:
             hit = cache.get(self._fp(node))
+            if tracer is not None:
+                tracer.emit(
+                    "plan_cache", node=type(node).__name__,
+                    hit=hit is not None,
+                )
             if hit is not None:
                 self._cte_cache[key] = hit
                 return hit
         m = getattr(self, f"_exec_{type(node).__name__.lower()}")
-        if TRACE_NODES is not None:
-            import time as _time
-
-            t0 = _time.perf_counter()
-            out = m(node)
+        if tracer is not None:
             # INCLUSIVE wall time (children execute inside this frame);
             # repeated visits are cte-cache dict hits, so each node records
-            # once per executor
-            TRACE_NODES.append(
-                (_time.perf_counter() - t0, type(node).__name__,
-                 P.explain(node).splitlines()[0][:90])
+            # once per executor. Spans emit in completion (post-) order
+            # with (exec_id, seq, depth) so the profiler can rebuild the
+            # tree and derive exclusive times.
+            depth = self._span_depth
+            self._span_depth = depth + 1
+            t0 = _perf()
+            try:
+                out = m(node)
+            finally:
+                self._span_depth = depth
+            dur_ms = (_perf() - t0) * 1000.0
+            self._span_seq += 1
+            tracer.emit(
+                "op_span",
+                exec_id=self._exec_id,
+                seq=self._span_seq,
+                depth=depth,
+                node=type(node).__name__,
+                explain=P.node_desc(node)[:90],
+                dur_ms=round(dur_ms, 3),
+                # nrows_known only: forcing a queued count would add a
+                # device sync to every traced node
+                rows=out.nrows_known,
+                est_bytes=table_device_bytes(out),
             )
         else:
             out = m(node)
@@ -1494,6 +1536,8 @@ class Executor:
         session = getattr(self.catalog, "session", None)
         if session is not None:
             session.last_blocked_union = self.last_blocked_union
+        if self.tracer is not None:
+            self.tracer.emit("blocked_union", **self.last_blocked_union)
 
     def _union_branch_aligners(self, tables):
         """Per-branch WINDOW aligners: unify column names (leftmost branch

@@ -293,10 +293,12 @@ def mark_blocked_union_aggs(node: PlanNode) -> int:
     return marked
 
 
-def explain(node: PlanNode, indent=0) -> str:
-    pad = "  " * indent
+def node_desc(node: PlanNode) -> str:
+    """One-line description of a SINGLE node — no child recursion (the
+    op-span tracer calls this per executed node; recursing would render
+    every subtree O(depth) times over a traced plan)."""
     name = type(node).__name__
-    desc = {
+    return {
         "Scan": lambda: f"Scan {node.table} as {node.alias}",
         "MaterializedScan": lambda: f"MaterializedScan {node.name}",
         "Project": lambda: f"Project [{', '.join(n for _, n in node.items)}]",
@@ -312,7 +314,11 @@ def explain(node: PlanNode, indent=0) -> str:
         "Distinct": lambda: "Distinct",
         "SetOp": lambda: f"SetOp {node.op}",
     }.get(name, lambda: name)()
-    out = pad + desc + "\n"
+
+
+def explain(node: PlanNode, indent=0) -> str:
+    pad = "  " * indent
+    out = pad + node_desc(node) + "\n"
     for c in node.children():
         if c is not None:
             out += explain(c, indent + 1)

@@ -10,6 +10,7 @@ SQL, and returns Arrow tables.
 from __future__ import annotations
 
 import os
+from time import perf_counter as _perf
 from typing import Optional
 
 import pyarrow as pa
@@ -19,7 +20,12 @@ from ..schema import get_schemas, get_maintenance_schemas
 from . import expr as E
 from . import plan as P
 from .binder import Binder
-from .columnar import Table, table_from_arrow, table_to_arrow
+from .columnar import (
+    Table,
+    table_device_bytes,
+    table_from_arrow,
+    table_to_arrow,
+)
 from .exec import Executor
 from .sql import ast as A
 from .sql.parser import parse_sql, parse_script
@@ -74,14 +80,9 @@ class _PlanResultCache:
         self.nbytes = 0
         self.scalars = {}  # fp -> (value, dtype, dictionary)
 
-    @staticmethod
-    def _table_bytes(table) -> int:
-        total = 0
-        for c in table.columns.values():
-            total += int(c.data.nbytes)
-            if c.valid is not None:
-                total += int(c.valid.nbytes)
-        return total
+    # the one byte-estimation rule, shared with the obs op_span est_bytes
+    # field (engine/columnar.py:table_device_bytes)
+    _table_bytes = staticmethod(table_device_bytes)
 
     def get(self, fp):
         hit = self.map.get(fp)
@@ -250,6 +251,8 @@ class Catalog:
             # exercises the transient-IO ladder rung end to end)
             faults.maybe_fire(f"load:{name}")
             faults.maybe_fire(name)
+        tracer = getattr(self.session, "tracer", None)
+        t0 = _perf() if tracer is not None else 0.0
         missing = [c for c in columns if c not in e.device_cols]
         if missing:
 
@@ -284,6 +287,20 @@ class Catalog:
             # all requested columns cached but nrows unset (can't happen in
             # practice; guard for empty column list)
             e.nrows = 0
+        if tracer is not None:
+            tracer.emit(
+                "catalog_load",
+                table=name,
+                columns=len(columns),
+                loaded=len(missing),
+                rows=e.nrows,
+                dur_ms=round((_perf() - t0) * 1000.0, 3),
+                cache=(
+                    "hit" if not missing
+                    else "miss" if len(missing) == len(columns)
+                    else "partial"
+                ),
+            )
         from ..schema import TABLE_PRIMARY_KEYS
 
         out = Table({c: e.device_cols[c] for c in columns}, e.nrows)
@@ -455,6 +472,13 @@ class Session:
         from .. import faults
 
         faults.install_from_env(self.conf)
+        # observability: with a trace dir configured (conf engine.trace_dir
+        # / env NDS_TRACE_DIR) every executor, catalog load, and harness
+        # report emits structured events into this session's own
+        # events-<appid>.jsonl; None = tracing disabled at zero cost
+        from ..obs.trace import tracer_from_conf
+
+        self.tracer = tracer_from_conf(self.conf)
         self.mesh = mesh
         self.catalog = Catalog(self)
         self._listeners = []  # task-failure observers (harness parity)

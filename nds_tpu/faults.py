@@ -231,6 +231,15 @@ class FaultRegistry:
         r = self._claim(site, substring, kinds)
         if r is None:
             return
+        # observability: record the injection in the bound tracer's event
+        # stream before the fault takes effect (a crash rule still leaves
+        # its own evidence behind — parents classify child deaths from it).
+        # Lazy import: the registry only reaches here when a rule fires.
+        from .obs import trace as _obs_trace
+
+        tracer = _obs_trace.current()
+        if tracer is not None:
+            tracer.emit("fault_injected", site=site, fault_kind=r.kind)
         if r.kind == "hang":
             print(f"faults: injected hang at {site!r} for {r.arg:.0f}s")
             time.sleep(r.arg)

@@ -39,6 +39,8 @@ import yaml
 
 from . import faults
 from .io.fs import fs_open, fs_open_atomic, get_fs, is_remote
+from .obs import reader as obs_reader
+from .obs import trace as obs_trace
 from .throughput import round_up_to_nearest_10_percent
 
 
@@ -367,39 +369,92 @@ class PhaseError(RuntimeError):
         self.kind = kind
 
 
-def _run_phase(state: BenchState, name: str, skip, fn):
+def _bench_trace_conf(params):
+    """Engine conf for trace-dir resolution: the power_test property file
+    is the one phase config that carries engine.* keys, so a conf-only
+    `engine.trace_dir` set there still lights up orchestrator-level phase
+    events and subprocess failure classification (env NDS_TRACE_DIR wins
+    either way inside resolve_trace_dir)."""
+    prop = (params.get("power_test") or {}).get("property_path")
+    if not prop:
+        return None
+    try:
+        from .power import load_properties
+
+        return load_properties(prop)
+    except OSError:
+        return None
+
+
+def _phase_failure_kind(exc, trace_dir, pre_existing) -> str:
+    """Classify a phase failure; when the exception itself is opaque (a
+    subprocess CalledProcessError carries only the exit code) fall back to
+    the event files the phase's child processes wrote before dying — the
+    "classify subprocess phase failures from their logs" ROADMAP gap."""
+    kind = faults.classify(exc)
+    if kind != faults.UNKNOWN or not trace_dir:
+        return kind
+    new = [
+        f
+        for f in obs_reader.discover_event_files(trace_dir)
+        if f not in pre_existing
+    ]
+    if not new:
+        return kind
+    from_events = obs_reader.failure_kind_from_files(new)
+    return from_events or kind
+
+
+def _run_phase(state: BenchState, name: str, skip, fn, tracer=None,
+               trace_dir=None):
     """Run one phase with checkpointing and bounded transient retries.
 
     Phase CLIs are rerun-idempotent (they overwrite their outputs), so a
     classified-transient failure retries up to NDS_PHASE_RETRIES times
-    with backoff. Deterministic failures (and unclassifiable subprocess
-    exits, unless NDS_PHASE_RETRY_UNKNOWN=1 opts in) raise immediately.
-    An injected crash (BaseException) sails through: the process dies with
-    the checkpoint recording every phase completed before it."""
-    if skip:
-        print(f"====== phase {name}: skipped (config) ======", flush=True)
-        return
-    if state.is_done(name):
-        print(f"====== phase {name}: skipped (checkpoint) ======", flush=True)
+    with backoff. Deterministic failures raise immediately; an
+    unclassifiable subprocess exit is first re-classified from the event
+    files its children wrote (NDS_TRACE_DIR), so e.g. a child that died
+    mid-stream on transient IO retries while a planner bug fails fast
+    (NDS_PHASE_RETRY_UNKNOWN=1 still opts genuinely-opaque exits into
+    retries). An injected crash (BaseException) sails through: the process
+    dies with the checkpoint recording every phase completed before it."""
+    if skip or state.is_done(name):
+        why = "config" if skip else "checkpoint"
+        print(f"====== phase {name}: skipped ({why}) ======", flush=True)
+        if tracer is not None:
+            tracer.emit("phase", phase=name, event="end", status="skipped",
+                        reason=why)
         return
     retries = int(os.environ.get("NDS_PHASE_RETRIES", "1"))
     retry_unknown = os.environ.get("NDS_PHASE_RETRY_UNKNOWN") == "1"
     base = float(os.environ.get("NDS_PHASE_BACKOFF", "1.0"))
     delays = faults.backoff_delays(retries, base)
+    if trace_dir is None:
+        trace_dir = obs_trace.resolve_trace_dir()
     attempt = 0
+    t0 = time.perf_counter()
+    if tracer is not None:
+        tracer.emit("phase", phase=name, event="begin")
     while True:
         attempt += 1
+        pre_existing = set(obs_reader.discover_event_files(trace_dir))
         try:
             faults.maybe_fire(name)
             fn()
             break
         except Exception as exc:
-            kind = faults.classify(exc)
+            kind = _phase_failure_kind(exc, trace_dir, pre_existing)
             transient = kind in faults.RETRYABLE or (
                 kind == faults.UNKNOWN and retry_unknown
             )
             delay = next(delays, None) if transient else None
             if delay is None:
+                if tracer is not None:
+                    tracer.emit(
+                        "phase", phase=name, event="end", status="failed",
+                        failure_kind=kind, attempts=attempt,
+                        dur_ms=round((time.perf_counter() - t0) * 1000, 3),
+                    )
                 raise PhaseError(name, kind, attempt, exc) from exc
             print(
                 f"====== phase {name}: attempt {attempt} failed "
@@ -407,6 +462,11 @@ def _run_phase(state: BenchState, name: str, skip, fn):
                 flush=True,
             )
             time.sleep(delay)
+    if tracer is not None:
+        tracer.emit(
+            "phase", phase=name, event="end", status="ok", attempts=attempt,
+            dur_ms=round((time.perf_counter() - t0) * 1000, 3),
+        )
     state.mark_done(name)
 
 
@@ -419,15 +479,35 @@ def run_full_bench(params, resume: bool = False):
             f"got {num_streams}"
         )
     faults.install_from_env()  # arm orchestrator-level injection sites
+    # orchestrator event log: per-phase begin/end events, orchestrator-level
+    # fault injections via the thread-local binding, and the trace dir the
+    # phase-failure classifier scans for child event files. Resolution:
+    # NDS_TRACE_DIR env, else engine.trace_dir from the power_test property
+    # file (the one phase config carrying engine.* keys); subprocesses
+    # inherit the env and write their own event files either way.
+    trace_conf = _bench_trace_conf(params)
+    tracer = obs_trace.tracer_from_conf(trace_conf)
+    trace_dir = obs_trace.resolve_trace_dir(trace_conf)
+    try:
+        with obs_trace.bind(tracer):
+            return _run_full_bench_phases(
+                params, resume, num_streams, tracer, trace_dir
+            )
+    finally:
+        if tracer is not None:
+            tracer.close()
+
+
+def _run_full_bench_phases(params, resume, num_streams, tracer, trace_dir):
     state = BenchState.load(params) if resume else BenchState.fresh(params)
     sq = num_streams // 2  # streams per Throughput Test
     _run_phase(
         state, "data_gen", params["data_gen"].get("skip"),
-        lambda: run_data_gen(params, num_streams),
+        lambda: run_data_gen(params, num_streams), tracer=tracer, trace_dir=trace_dir,
     )
     _run_phase(
         state, "load_test", params["load_test"].get("skip"),
-        lambda: run_load_test(params),
+        lambda: run_load_test(params), tracer=tracer, trace_dir=trace_dir,
     )
     load_report = params["load_test"]["report_path"]
     tload = get_load_time(load_report)
@@ -436,34 +516,35 @@ def run_full_bench(params, resume: bool = False):
         lambda: gen_streams(
             params, num_streams, get_load_end_timestamp(load_report)
         ),
+        tracer=tracer, trace_dir=trace_dir,
     )
     _run_phase(
         state, "power_test", params["power_test"].get("skip"),
-        lambda: power_test(params),
+        lambda: power_test(params), tracer=tracer, trace_dir=trace_dir,
     )
     tpower = get_power_time(params["power_test"]["report_path"])
     tt_cfg = params["throughput_test"]
     dm_cfg = params["maintenance_test"]
     _run_phase(
         state, "throughput_test_1", tt_cfg.get("skip"),
-        lambda: throughput_test(params, num_streams, 1),
+        lambda: throughput_test(params, num_streams, 1), tracer=tracer, trace_dir=trace_dir,
     )
     ttt1 = get_throughput_time(tt_cfg["report_base_path"], num_streams, 1)
     _run_phase(
         state, "maintenance_test_1", dm_cfg.get("skip"),
-        lambda: maintenance_test(params, num_streams, 1),
+        lambda: maintenance_test(params, num_streams, 1), tracer=tracer, trace_dir=trace_dir,
     )
     tdm1 = get_maintenance_time(
         dm_cfg["maintenance_report_base_path"], num_streams, 1
     )
     _run_phase(
         state, "throughput_test_2", tt_cfg.get("skip"),
-        lambda: throughput_test(params, num_streams, 2),
+        lambda: throughput_test(params, num_streams, 2), tracer=tracer, trace_dir=trace_dir,
     )
     ttt2 = get_throughput_time(tt_cfg["report_base_path"], num_streams, 2)
     _run_phase(
         state, "maintenance_test_2", dm_cfg.get("skip"),
-        lambda: maintenance_test(params, num_streams, 2),
+        lambda: maintenance_test(params, num_streams, 2), tracer=tracer, trace_dir=trace_dir,
     )
     tdm2 = get_maintenance_time(
         dm_cfg["maintenance_report_base_path"], num_streams, 2

@@ -76,6 +76,16 @@ def _open_remote_with_retries(path, mode):
                 f"fs: transient io failure opening {path} ({exc}); "
                 f"retrying in {delay:.2f}s"
             )
+            # observability: record the retry in the bound stream's event
+            # log (lazy import, and only on the already-slow retry path)
+            from ..obs import trace as _obs_trace
+
+            tracer = _obs_trace.current()
+            if tracer is not None:
+                tracer.emit(
+                    "io_retry", path=str(path), error=str(exc)[:200],
+                    delay_s=round(delay, 3),
+                )
             time.sleep(delay)
 
 

@@ -15,6 +15,7 @@ import os
 import time
 from datetime import datetime
 
+from . import faults
 from .check import check_json_summary_folder
 from .engine.session import Session
 from .io.fs import fs_open_atomic
@@ -90,8 +91,11 @@ def get_maintenance_queries(session, folder, valid_queries):
 
 
 def run_dm_query(session, query_list, query_name):
-    for q in query_list:
-        session.run_script(q)
+    # scope labels the engine's trace events (op_span/catalog_load/...)
+    # with the refresh function, exactly like power's per-query scope
+    with faults.scope(query_name):
+        for q in query_list:
+            session.run_script(q)
 
 
 # staging tables each refresh function reads (spec 5.3.11); the delete-date
@@ -169,7 +173,7 @@ def run_maintenance(
         print(f"====== Run {query_name} ======")
         q_report = BenchReport(session)
         summary = q_report.report_on(
-            run_dm_query, session, q_content, query_name
+            run_dm_query, session, q_content, query_name, name=query_name
         )
         print(f"Time taken: {summary['queryTimes']} millis for {query_name}")
         execution_time_list.append((app_id, query_name, summary["queryTimes"]))

@@ -1,0 +1,18 @@
+"""Observability subsystem: structured event tracing + post-run profiling.
+
+`trace` — the Tracer (JSON-lines event log, `NDS_TRACE_DIR` /
+`engine.trace_dir`), the golden event schema, and thread-local binding.
+`memwatch` — per-query device-memory/RSS high-water sampling.
+`reader` — event-log parsing, validation, fold-in summaries, operator
+aggregation, and A/B comparison (backing `nds_tpu/cli/profile.py`).
+"""
+
+from .trace import (  # noqa: F401
+    EVENT_SCHEMA,
+    Tracer,
+    bind,
+    current,
+    resolve_trace_dir,
+    tracer_from_conf,
+)
+from .memwatch import MemorySampler  # noqa: F401

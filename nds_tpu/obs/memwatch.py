@@ -1,0 +1,95 @@
+"""Per-query memory high-water sampling for the observability stream.
+
+Preferred source: jax device memory stats (`Device.memory_stats()["bytes_in_use"]`,
+available on real accelerator backends) summed over local devices. Fallback:
+process RSS from /proc/self/statm (the CPU backend allocates query
+intermediates in host memory, so RSS is the honest proxy there — and it is
+also the signal the ROADMAP's host-OOM pre-emption item will watch).
+
+The sampler is a daemon thread started only while a traced query runs
+(BenchReport gates it on the session tracer), so with tracing off it costs
+nothing. Interval knob: NDS_TRACE_MEM_INTERVAL_MS (default 50).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_PAGE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
+def device_bytes_in_use():
+    """Total bytes_in_use over local jax devices, or None when the backend
+    exposes no memory stats (CPU), or jax isn't importable here."""
+    try:
+        import jax
+
+        total = 0
+        seen = False
+        for d in jax.local_devices():
+            stats = d.memory_stats()
+            if stats and stats.get("bytes_in_use") is not None:
+                total += int(stats["bytes_in_use"])
+                seen = True
+        return total if seen else None
+    except Exception:
+        return None
+
+
+def rss_bytes():
+    """Resident set size from /proc/self/statm, or None off-Linux."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * _PAGE
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+class MemorySampler:
+    """Background high-water sampler: max over periodic samples of the best
+    available memory signal. Use as a context manager; read `.peak_bytes`
+    (int | None) and `.source` ("device" | "rss" | None) after exit."""
+
+    def __init__(self, interval_s: float | None = None):
+        if interval_s is None:
+            interval_s = (
+                float(os.environ.get("NDS_TRACE_MEM_INTERVAL_MS", "50")) / 1000
+            )
+        self.interval_s = max(interval_s, 0.001)
+        self.peak_bytes = None
+        self.source = None
+        self._stop = threading.Event()
+        self._thread = None
+        # probe once up front so source selection is stable for the run
+        if device_bytes_in_use() is not None:
+            self._read, self.source = device_bytes_in_use, "device"
+        elif rss_bytes() is not None:
+            self._read, self.source = rss_bytes, "rss"
+        else:
+            self._read = None
+
+    def _sample(self):
+        v = self._read()
+        if v is not None and (self.peak_bytes is None or v > self.peak_bytes):
+            self.peak_bytes = v
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            self._sample()
+
+    def __enter__(self):
+        if self._read is not None:
+            self._sample()
+            self._thread = threading.Thread(
+                target=self._loop, name="nds-obs-memwatch", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._sample()  # final reading: catch an end-of-query peak
+        return False

@@ -1,0 +1,324 @@
+"""Event-log reading + aggregation: the analysis half of the obs subsystem.
+
+Consumed by `nds_tpu/cli/profile.py` (operator breakdowns, A/B compare),
+by the throughput parent (fold-in + failure classification of child-stream
+event files), and by full_bench (classifying a subprocess phase failure
+from the events the child wrote before dying — the parent only sees an
+exit code, closing the ROADMAP gap).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+from .. import faults
+from .trace import EVENT_SCHEMA
+
+#: injected-fault kind -> failure-taxonomy kind (faults.classify vocabulary)
+_FAULT_KIND_MAP = {
+    "oom": faults.DEVICE_OOM,
+    "hostoom": faults.HOST_OOM,
+    "io": faults.IO_TRANSIENT,
+    "hang": faults.TIMEOUT,
+    "crash": faults.UNKNOWN,  # simulated process death: nothing retryable
+}
+
+
+class MalformedEventError(ValueError):
+    """An event line that is not valid JSON (other than a torn final line,
+    which a crash legitimately leaves behind and readers skip)."""
+
+
+def discover_event_files(trace_dir) -> list:
+    """All event logs under a trace dir, sorted by name (name embeds the
+    app id, so order is stable across discovery calls)."""
+    if not trace_dir:
+        return []
+    return sorted(glob.glob(os.path.join(str(trace_dir), "events-*.jsonl")))
+
+
+def iter_events(path, strict: bool = True):
+    """Yield events from one JSONL file.
+
+    A torn FINAL line (no trailing newline — the single-write+flush
+    contract means only a crash mid-write can produce one) is skipped in
+    both modes. Any other malformed line raises MalformedEventError when
+    `strict`, else is skipped."""
+    with open(path, encoding="utf-8") as f:
+        raw = f.read()
+    lines = raw.split("\n")
+    tail = None
+    if not raw.endswith("\n") and lines:
+        tail = lines.pop()  # candidate torn final line
+    for i, line in enumerate(lines):
+        if not line:
+            continue
+        try:
+            yield json.loads(line)
+        except json.JSONDecodeError:
+            if strict:
+                raise MalformedEventError(
+                    f"{path}:{i + 1}: malformed event line: {line[:120]!r}"
+                )
+    if tail:
+        try:
+            yield json.loads(tail)
+        except json.JSONDecodeError:
+            pass  # torn final line: tolerated evidence of a crash
+
+
+def read_events(paths, strict: bool = True) -> list:
+    """Events from one path or a list of paths (files or trace dirs),
+    concatenated in file order."""
+    if isinstance(paths, (str, os.PathLike)):
+        paths = [paths]
+    files = []
+    for p in paths:
+        p = str(p)
+        if os.path.isdir(p):
+            files.extend(discover_event_files(p))
+        else:
+            files.append(p)
+    out = []
+    for f in files:
+        out.extend(iter_events(f, strict=strict))
+    return out
+
+
+def validate_events(events) -> list:
+    """Schema problems as strings (empty == clean): unknown kinds and
+    missing per-kind required fields (EVENT_SCHEMA is the contract)."""
+    problems = []
+    for i, ev in enumerate(events):
+        kind = ev.get("kind")
+        if kind is None or "ts" not in ev or "app" not in ev:
+            problems.append(f"event {i}: missing ts/kind/app: {ev}")
+            continue
+        req = EVENT_SCHEMA.get(kind)
+        if req is None:
+            problems.append(f"event {i}: unknown kind {kind!r}")
+            continue
+        missing = [f for f in req if f not in ev]
+        if missing:
+            problems.append(f"event {i} ({kind}): missing fields {missing}")
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# stream summaries + failure classification (fold-in consumers)
+# ---------------------------------------------------------------------------
+
+
+def summarize_stream(events) -> dict:
+    """Roll one (child) stream's events up for the parent's fold-in event:
+    query statuses, failure kinds, and tallies the profiler also reports."""
+    queries = {}
+    for ev in events:
+        if ev.get("kind") == "query_span":
+            queries[ev.get("query")] = {
+                "status": ev.get("status"),
+                "failure_kind": ev.get("failure_kind"),
+            }
+    failed = {
+        q: (v["failure_kind"] or faults.UNKNOWN)
+        for q, v in queries.items()
+        if v["status"] == "Failed"
+    }
+    return {
+        "queries": len(queries),
+        "completed": sum(
+            1 for v in queries.values() if v["status"] != "Failed"
+        ),
+        "failed": failed,
+        "failure_kinds": sorted(set(failed.values())),
+    }
+
+
+def failure_kind_from_events(events):
+    """Best-effort failure classification from a stream's event log, for a
+    parent that only saw a nonzero exit code: the last Failed query_span's
+    kind wins (a recorded failure is the strongest evidence); only when NO
+    query failed does the last injected fault's mapped kind stand in (e.g.
+    a crash rule that killed the process before any span was written)."""
+    failed_kind = None
+    fault_kind = None
+    for ev in events:
+        k = ev.get("kind")
+        if k == "query_span" and ev.get("status") == "Failed":
+            failed_kind = ev.get("failure_kind") or faults.UNKNOWN
+        elif k == "fault_injected":
+            fault_kind = _FAULT_KIND_MAP.get(
+                ev.get("fault_kind"), faults.UNKNOWN
+            )
+    return failed_kind or fault_kind
+
+
+def failure_kind_from_files(paths):
+    try:
+        return failure_kind_from_events(read_events(paths, strict=False))
+    except OSError:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# operator-level aggregation (the profiler's core)
+# ---------------------------------------------------------------------------
+
+
+def op_spans_with_exclusive(events) -> list:
+    """op_span events with an `excl_ms` field added.
+
+    Spans are emitted in completion (post-) order with `depth` and a
+    per-executor `seq`; within one (app, query, exec_id) group a child
+    completes before its parent, so exclusive time falls out of one pass:
+    excl(parent at depth d) = incl - sum(incl of direct children at d+1)."""
+    groups = {}
+    for ev in events:
+        if ev.get("kind") != "op_span":
+            continue
+        key = (ev.get("app"), ev.get("query"), ev.get("exec_id"))
+        groups.setdefault(key, []).append(ev)
+    out = []
+    for spans in groups.values():
+        spans.sort(key=lambda e: e.get("seq", 0))
+        acc = {}  # depth -> accumulated child inclusive ms awaiting a parent
+        for ev in spans:
+            d = ev.get("depth", 0)
+            incl = float(ev.get("dur_ms") or 0.0)
+            excl = max(incl - acc.pop(d + 1, 0.0), 0.0)
+            acc[d] = acc.get(d, 0.0) + incl
+            ev = dict(ev)
+            ev["excl_ms"] = excl
+            out.append(ev)
+    return out
+
+
+_EMPTY_QUERY = {
+    "wall_ms": None, "status": None, "runs": 0, "ops": {},
+    "root_incl_ms": 0.0,
+}
+
+
+def profile_events(events) -> dict:
+    """The aggregate the profiler renders: per-query wall/status/memory and
+    per-operator breakdowns, run-wide operator totals, and tallies.
+
+    Multi-stream semantics: profiling several streams' files together (a
+    throughput run's trace dir) keys by query NAME and SUMS across streams
+    — wall_ms is the total across the query's `runs` query_spans, operator
+    times sum the same way (so plan time stays bounded by wall time), any
+    Failed run marks the query Failed, and memory high-water is the max."""
+    spans = op_spans_with_exclusive(events)
+    queries = {}
+    op_totals = {}
+    for ev in spans:
+        q = ev.get("query") or "<unscoped>"
+        node = ev.get("node", "?")
+        qrec = queries.setdefault(q, dict(_EMPTY_QUERY, ops={}))
+        op = qrec["ops"].setdefault(
+            node, {"count": 0, "incl_ms": 0.0, "excl_ms": 0.0, "rows": 0}
+        )
+        op["count"] += 1
+        op["incl_ms"] += float(ev.get("dur_ms") or 0.0)
+        op["excl_ms"] += ev["excl_ms"]
+        if ev.get("rows") is not None:
+            op["rows"] += int(ev["rows"])
+        if ev.get("depth", 0) == 0:
+            qrec["root_incl_ms"] += float(ev.get("dur_ms") or 0.0)
+        tot = op_totals.setdefault(
+            node, {"count": 0, "incl_ms": 0.0, "excl_ms": 0.0, "rows": 0}
+        )
+        tot["count"] += 1
+        tot["incl_ms"] += float(ev.get("dur_ms") or 0.0)
+        tot["excl_ms"] += ev["excl_ms"]
+        if ev.get("rows") is not None:
+            tot["rows"] += int(ev["rows"])
+    tallies = {
+        "plan_cache_hits": 0,
+        "plan_cache_misses": 0,
+        "catalog_loads": 0,
+        "catalog_cache_hits": 0,
+        "io_retries": 0,
+        "ladder_rungs": 0,
+        "watchdog_fires": 0,
+        "faults_injected": 0,
+        "blocked_union_windows": 0,
+    }
+    for ev in events:
+        k = ev.get("kind")
+        if k == "query_span":
+            q = queries.setdefault(
+                ev.get("query") or "<unscoped>", dict(_EMPTY_QUERY, ops={})
+            )
+            q["wall_ms"] = (q["wall_ms"] or 0.0) + float(ev.get("dur_ms") or 0.0)
+            q["runs"] += 1
+            if q["status"] != "Failed":  # any failed run surfaces
+                q["status"] = ev.get("status")
+            if ev.get("failure_kind"):
+                q["failure_kind"] = ev["failure_kind"]
+            if ev.get("mem_hw_bytes") is not None:
+                q["mem_hw_bytes"] = max(
+                    int(ev["mem_hw_bytes"]), q.get("mem_hw_bytes", 0)
+                )
+                q["mem_source"] = ev.get("mem_source")
+        elif k == "plan_cache":
+            tallies["plan_cache_hits" if ev.get("hit") else "plan_cache_misses"] += 1
+        elif k == "catalog_load":
+            tallies["catalog_loads"] += 1
+            if ev.get("cache") == "hit":
+                tallies["catalog_cache_hits"] += 1
+        elif k == "io_retry":
+            tallies["io_retries"] += 1
+        elif k == "ladder_rung":
+            tallies["ladder_rungs"] += 1
+        elif k == "watchdog_fire":
+            tallies["watchdog_fires"] += 1
+        elif k == "fault_injected":
+            tallies["faults_injected"] += 1
+        elif k == "blocked_union":
+            tallies["blocked_union_windows"] += int(ev.get("windows") or 0)
+    return {"queries": queries, "op_totals": op_totals, "tallies": tallies}
+
+
+def compare_profiles(old: dict, new: dict, ratio: float = 1.25,
+                     min_ms: float = 50.0) -> list:
+    """Per-query wall-time and per-(query, operator) exclusive-time
+    regressions between two profiles. A regression flags when new >= old *
+    `ratio` AND the absolute delta >= `min_ms` (tiny operators jitter).
+    Returns records sorted worst-first; disappearing/appearing queries are
+    reported as `status_change` records."""
+    out = []
+    oq, nq = old["queries"], new["queries"]
+    for q in sorted(set(oq) | set(nq)):
+        o, n = oq.get(q), nq.get(q)
+        if o is None or n is None:
+            out.append({
+                "level": "query", "query": q, "change": "status_change",
+                "detail": "only in new run" if o is None else "only in old run",
+            })
+            continue
+        if (o.get("status") != "Failed") and n.get("status") == "Failed":
+            out.append({
+                "level": "query", "query": q, "change": "status_change",
+                "detail": f"now Failed ({n.get('failure_kind', 'unknown')})",
+            })
+            continue
+        ow, nw = o.get("wall_ms"), n.get("wall_ms")
+        if ow and nw and nw >= ow * ratio and nw - ow >= min_ms:
+            out.append({
+                "level": "query", "query": q, "change": "regression",
+                "old_ms": ow, "new_ms": nw, "ratio": nw / ow,
+            })
+        for node in sorted(set(o["ops"]) | set(n["ops"])):
+            oe = o["ops"].get(node, {}).get("excl_ms", 0.0)
+            ne = n["ops"].get(node, {}).get("excl_ms", 0.0)
+            if oe and ne >= oe * ratio and ne - oe >= min_ms:
+                out.append({
+                    "level": "operator", "query": q, "node": node,
+                    "change": "regression",
+                    "old_ms": oe, "new_ms": ne, "ratio": ne / oe,
+                })
+    out.sort(key=lambda r: -r.get("ratio", float("inf")))
+    return out

@@ -1,0 +1,191 @@
+"""Structured run-wide event tracing: the engine's own observability stream.
+
+The reference harness is measured entirely through Spark's instrumentation —
+event logs, per-task metrics, and the RAPIDS profiling/qualification tools
+that post-process them. This engine has no Spark underneath, so the
+equivalent seam lives here: a `Tracer` appends JSON-lines events to
+`events-<appid>.jsonl` under a trace directory (`NDS_TRACE_DIR` env / conf
+`engine.trace_dir`), one self-contained JSON object per line, and
+`nds_tpu/cli/profile.py` is the post-processor (the local analogue of the
+reference's profiling tool over Spark event logs).
+
+Zero-cost contract: with no trace dir configured, `tracer_from_conf` returns
+None, `Session.tracer` is None, and every instrumentation point in the hot
+path is a single attribute-load + `is None` check.
+
+Crash-safety contract: each event is written with ONE `write()` call of a
+complete line and flushed, so a reader never sees an interleaved line from
+two threads and a crashed process leaves at most one torn FINAL line (which
+readers tolerate; any earlier malformed line is a hard error —
+`obs.reader.iter_events`).
+
+Event taxonomy (golden schema — tests/test_obs.py asserts it):
+every event carries `ts` (epoch ms), `kind`, `app`, and (when a query scope
+is active, `faults.scope`) `query`; per-kind required fields are listed in
+EVENT_SCHEMA below.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+
+from .. import faults
+from .. import __version__
+
+#: kind -> tuple of required per-kind fields (beyond ts/kind/app).
+#: Optional fields events may also carry are documented in README
+#: "Observability". This mapping is the schema contract the golden test and
+#: `profile --check`/`obs.reader.validate_events` enforce.
+EVENT_SCHEMA = {
+    # first line of every file: identifies the producing process
+    "trace_meta": ("pid", "version"),
+    # one per executed plan node (inclusive wall time; children nest inside)
+    "op_span": ("exec_id", "seq", "depth", "node", "explain", "dur_ms",
+                "rows", "est_bytes"),
+    # one per benchmarked query/function (BenchReport.report_on)
+    "query_span": ("query", "dur_ms", "status", "retries"),
+    # catalog table load (cache: "hit" | "partial" | "miss")
+    "catalog_load": ("table", "columns", "loaded", "rows", "dur_ms", "cache"),
+    # session plan-result cache probe on a cacheable plan node
+    "plan_cache": ("node", "hit"),
+    # blocked union-aggregation completed (PR 1 window stats)
+    "blocked_union": ("windows", "window_rows", "total_rows"),
+    # a fault-injection rule fired (faults.FaultRegistry)
+    "fault_injected": ("site", "fault_kind"),
+    # one degradation-ladder rung taken (BenchReport)
+    "ladder_rung": ("query", "rung", "failure_kind"),
+    # the per-query watchdog abandoned a hung attempt
+    "watchdog_fire": ("query", "budget_s"),
+    # a transient remote-IO failure was retried (io/fs.py)
+    "io_retry": ("path", "error", "delay_s"),
+    # full_bench orchestrator phase boundary (event: "begin" | "end")
+    "phase": ("phase", "event"),
+    # parent fold-in of one throughput child stream's event file(s)
+    "child_stream": ("stream", "files", "queries", "completed", "failed"),
+}
+
+
+def resolve_trace_dir(conf: dict | None = None) -> str | None:
+    """Trace directory from conf `engine.trace_dir`, else NDS_TRACE_DIR;
+    None (tracing disabled) when neither is set."""
+    v = None
+    if conf:
+        v = conf.get("engine.trace_dir")
+    v = v or os.environ.get("NDS_TRACE_DIR")
+    return str(v) if v else None
+
+
+def default_app_id() -> str:
+    """Unique per-tracer app id: pid + epoch second + random suffix (two
+    thread-mode throughput streams in one process must not collide)."""
+    return f"nds-tpu-{os.getpid()}-{int(time.time())}-{uuid.uuid4().hex[:6]}"
+
+
+class Tracer:
+    """Append-only JSON-lines event writer (or an in-memory collector when
+    `trace_dir` is None — the dev-tool mode tools/trace_query.py uses).
+
+    Thread-safe: a lock serializes writes, and each event line is emitted
+    with a single write() + flush so concurrent streams/threads sharing a
+    tracer never interleave mid-line."""
+
+    def __init__(self, trace_dir: str | None = None, app_id: str | None = None):
+        self.app_id = app_id or default_app_id()
+        self.trace_dir = trace_dir
+        self.path = (
+            os.path.join(trace_dir, f"events-{self.app_id}.jsonl")
+            if trace_dir
+            else None
+        )
+        self.events: list[dict] | None = None if trace_dir else []
+        self._fh = None
+        self._lock = threading.Lock()
+        self._broken = False
+        if trace_dir:
+            # eager meta line: the file exists (and is discoverable by a
+            # parent/orchestrator) even if the process dies before its
+            # first real event
+            self.emit("trace_meta", pid=os.getpid(), version=__version__)
+
+    # ------------------------------------------------------------------
+    def emit(self, kind: str, **fields):
+        """Record one event. `ts`/`kind`/`app` are added here; `query` is
+        added from the active faults.scope when the caller didn't pass it."""
+        ev = {"ts": int(time.time() * 1000), "kind": kind, "app": self.app_id}
+        if "query" not in fields:
+            scope = faults.current_scope()
+            if scope is not None:
+                ev["query"] = scope
+        ev.update(fields)
+        line = json.dumps(ev, default=str)
+        with self._lock:
+            if self.events is not None:
+                self.events.append(ev)
+                return
+            if self._broken:
+                return
+            try:
+                if self._fh is None:
+                    parent = os.path.dirname(self.path)
+                    if parent:
+                        os.makedirs(parent, exist_ok=True)
+                    self._fh = open(self.path, "a", encoding="utf-8")
+                self._fh.write(line + "\n")
+                self._fh.flush()
+            except OSError as exc:
+                # observability must never take the benchmark down: an
+                # unwritable trace dir disables this tracer, loudly, once
+                self._broken = True
+                print(f"obs: disabling tracer ({self.path}: {exc})")
+
+    def close(self):
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+def tracer_from_conf(conf: dict | None = None, app_id: str | None = None):
+    """A file-backed Tracer when a trace dir is configured, else None (the
+    zero-cost disabled state every instrumentation point checks for)."""
+    d = resolve_trace_dir(conf)
+    if not d:
+        return None
+    return Tracer(d, app_id=app_id)
+
+
+# ---------------------------------------------------------------------------
+# thread-local binding: layers without a Session in hand (faults, io/fs)
+# reach the right stream's tracer through `current()`
+# ---------------------------------------------------------------------------
+
+_tls = threading.local()
+
+
+class bind:
+    """Context manager binding a tracer (or None: no-op) to this thread so
+    session-less layers (fault registry, fs retries) can emit into the
+    stream that is actually running. Harness loops bind their session's
+    tracer around query execution; BenchReport re-binds inside its watchdog
+    worker thread (thread-locals don't inherit)."""
+
+    def __init__(self, tracer: Tracer | None):
+        self.tracer = tracer
+
+    def __enter__(self):
+        self.prev = getattr(_tls, "tracer", None)
+        _tls.tracer = self.tracer
+        return self.tracer
+
+    def __exit__(self, *exc):
+        _tls.tracer = self.prev
+        return False
+
+
+def current() -> Tracer | None:
+    """The tracer bound to this thread, or None (events dropped)."""
+    return getattr(_tls, "tracer", None)

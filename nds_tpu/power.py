@@ -59,12 +59,15 @@ def get_query_subset(query_dict, subset):
 
 def setup_tables(session, input_prefix, input_format, use_decimal, execution_time_list, app_id):
     """Register every source table on the session, timing each registration
-    (reference analogue: per-table temp-view creation, nds/nds_power.py:79-106)."""
+    (reference analogue: per-table temp-view creation, nds/nds_power.py:79-106).
+    Elapsed times use the monotonic clock (an NTP step mid-setup must not
+    corrupt a duration); the CSV rows carry durations only, so the epoch
+    timestamp contract is untouched."""
     import glob
 
     schemas = get_schemas(use_decimal)
     for table_name, schema in schemas.items():
-        start = int(time.time() * 1000)
+        start = time.perf_counter()
         table_path = os.path.join(input_prefix, table_name)
         if input_format == "csv":
             # raw generator output (pipe-delimited .dat chunks) vs a
@@ -81,11 +84,11 @@ def setup_tables(session, input_prefix, input_format, use_decimal, execution_tim
             session.register_lakehouse(table_name, table_path, schema)
         else:
             raise ValueError(f"unsupported input format {input_format}")
-        end = int(time.time() * 1000)
+        dur_ms = int((time.perf_counter() - start) * 1000)
         print(f"====== Creating TempView for table {table_name} ======")
-        print(f"Time taken: {end - start} millis for table {table_name}")
+        print(f"Time taken: {dur_ms} millis for table {table_name}")
         execution_time_list.append(
-            (app_id, f"CreateTempView {table_name}", end - start)
+            (app_id, f"CreateTempView {table_name}", dur_ms)
         )
     return execution_time_list
 
@@ -164,7 +167,8 @@ def run_query_stream(
     Returns the session (so callers like the throughput driver can reuse it).
     """
     execution_time_list = []
-    total_time_start = time.time()
+    total_time_start = time.time()  # epoch: app-id stamp only
+    total_start_mono = time.perf_counter()  # elapsed measurements
     app_name = (
         "NDS - " + next(iter(query_dict)) if len(query_dict) == 1 else "NDS - Power Run"
     )
@@ -201,26 +205,37 @@ def run_query_stream(
         power_start = int(gate_t) if gate_t is not None else int(time.time())
     else:
         power_start = int(time.time())
-    for query_name, q_content in query_dict.items():
-        print(f"====== Run {query_name} ======")
-        q_report = BenchReport(session)
-        summary = q_report.report_on(
-            run_one_query, session, q_content, query_name, output_path,
-            output_format, retry_oom=True,  # read-only: idempotent
-        )
-        print(f"Time taken: {summary['queryTimes']} millis for {query_name}")
-        execution_time_list.append((app_id, query_name, summary["queryTimes"][0]))
-        if json_summary_folder:
-            if property_file:
-                summary_prefix = os.path.join(
-                    json_summary_folder, os.path.basename(property_file).split(".")[0]
-                )
-            else:
-                summary_prefix = os.path.join(json_summary_folder, "")
-            q_report.write_summary(query_name, prefix=summary_prefix)
+    # epoch Power Start/End rows are the CSV time-log contract (Ttt reads
+    # them across streams); the ELAPSED figures are monotonic so a clock
+    # step mid-run cannot corrupt Tpower
+    power_start_mono = time.perf_counter()
+    # bind this stream's tracer to the driver thread: session-less layers
+    # (fault registry, fs retries) emit into the right stream's event file
+    # (BenchReport re-binds inside its watchdog worker thread itself)
+    from .obs import trace as obs_trace
+
+    with obs_trace.bind(session.tracer):
+        for query_name, q_content in query_dict.items():
+            print(f"====== Run {query_name} ======")
+            q_report = BenchReport(session)
+            summary = q_report.report_on(
+                run_one_query, session, q_content, query_name, output_path,
+                output_format, retry_oom=True,  # read-only: idempotent
+                name=query_name,
+            )
+            print(f"Time taken: {summary['queryTimes']} millis for {query_name}")
+            execution_time_list.append((app_id, query_name, summary["queryTimes"][0]))
+            if json_summary_folder:
+                if property_file:
+                    summary_prefix = os.path.join(
+                        json_summary_folder, os.path.basename(property_file).split(".")[0]
+                    )
+                else:
+                    summary_prefix = os.path.join(json_summary_folder, "")
+                q_report.write_summary(query_name, prefix=summary_prefix)
     power_end = int(time.time())
-    power_elapse = int((power_end - power_start) * 1000)
-    total_elapse = int((time.time() - total_time_start) * 1000)
+    power_elapse = int((time.perf_counter() - power_start_mono) * 1000)
+    total_elapse = int((time.perf_counter() - total_start_mono) * 1000)
     print(f"====== Power Test Time: {power_elapse} milliseconds ======")
     print(f"====== Total Time: {total_elapse} milliseconds ======")
     execution_time_list.append((app_id, "Power Start Time", power_start))

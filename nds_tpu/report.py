@@ -20,6 +20,17 @@ carries `failureKind`.
 
 The summary field set and the `<prefix>-<query>-<startTime>.json` filename
 contract are kept identical so downstream report tooling ports unchanged.
+Field-name contract: `env.sparkConf` / `env.sparkVersion` are the
+compatibility keys existing report pipelines parse; `env.engineConf` /
+`env.engineVersion` are first-class aliases carrying the same values —
+new tooling should read the engine* names, and both are guaranteed equal.
+
+Observability: when the session carries a tracer (NDS_TRACE_DIR /
+engine.trace_dir), report_on emits a `query_span` event per benchmarked
+callable (status, duration, retries, memory high-water), a `ladder_rung`
+event per recovery rung, and a `watchdog_fire` event when the per-query
+watchdog abandons a hung attempt; a MemorySampler records the query's
+device-memory (or RSS) high-water into both the event and the summary.
 """
 
 from __future__ import annotations
@@ -34,6 +45,8 @@ import jax
 
 from . import faults
 from .io.fs import fs_open_atomic, io_retry_budget
+from .obs import trace as obs_trace
+from .obs.memwatch import MemorySampler
 
 from . import __version__
 
@@ -80,11 +93,17 @@ class BenchReport:
 
     def __init__(self, session) -> None:
         self.session = session
+        self.tracer = getattr(session, "tracer", None)
         self.summary = {
             "env": {
                 "envVars": {},
-                "sparkConf": {},  # key kept for report-pipeline compatibility
+                # sparkConf/sparkVersion: kept for report-pipeline
+                # compatibility; engineConf/engineVersion are the
+                # first-class aliases (always equal — see module docstring)
+                "sparkConf": {},
                 "sparkVersion": None,
+                "engineConf": {},
+                "engineVersion": None,
             },
             "queryStatus": [],
             "exceptions": [],
@@ -92,6 +111,7 @@ class BenchReport:
             "queryTimes": [],
             "retries": 0,
         }
+        self._name = None  # query/function label for emitted events
 
     # ------------------------------------------------------------------
     # single attempt, optionally under the watchdog
@@ -124,7 +144,11 @@ class BenchReport:
 
         def _worker():
             try:
-                box["err"] = _call()
+                # re-bind the session tracer: thread-locals don't inherit,
+                # and session-less layers (fault registry, fs retries) find
+                # their tracer through the thread-local binding
+                with obs_trace.bind(self.tracer):
+                    box["err"] = _call()
             except BaseException as e:  # InjectedCrash: re-raise on caller
                 box["crash"] = e
             finally:
@@ -135,6 +159,10 @@ class BenchReport:
         )
         t.start()
         if not done.wait(timeout):
+            if self.tracer is not None:
+                self.tracer.emit(
+                    "watchdog_fire", query=self._name, budget_s=timeout
+                )
             return (
                 f"{_WATCHDOG_MARK}: query exceeded the {timeout:.1f}s budget "
                 f"(engine.query_timeout / NDS_QUERY_TIMEOUT); worker abandoned"
@@ -198,21 +226,30 @@ class BenchReport:
             return {"delay_s": round(delay, 3)}
         return None
 
-    def report_on(self, fn: Callable, *args, retry_oom: bool = False):
+    def report_on(self, fn: Callable, *args, retry_oom: bool = False,
+                  name: str = None):
         """Run fn(*args), recording env (secrets redacted), status and time.
 
         retry_oom: allow the retrying ladder rungs (caller must guarantee
         fn is idempotent — read-only queries yes, DML no). Non-idempotent
         callables still get classification, the watchdog, and full attempt
-        records; they just never re-run."""
+        records; they just never re-run.
+
+        name: query/function label for emitted trace events (the summary
+        itself gets its name later, in write_summary)."""
+        self._name = name
         env_vars = {
             k: v
             for k, v in os.environ.items()
             if not any(tag in k.upper() for tag in _REDACTED)
         }
         self.summary["env"]["envVars"] = env_vars
-        self.summary["env"]["sparkConf"] = engine_conf(self.session)
-        self.summary["env"]["sparkVersion"] = f"nds-tpu {__version__}"
+        conf = engine_conf(self.session)
+        version = f"nds-tpu {__version__}"
+        self.summary["env"]["sparkConf"] = conf
+        self.summary["env"]["sparkVersion"] = version
+        self.summary["env"]["engineConf"] = conf
+        self.summary["env"]["engineVersion"] = version
         failures: list[str] = []
         registered = False
         try:
@@ -222,9 +259,15 @@ class BenchReport:
             pass
         timeout = query_timeout(self.session)
         start_time = int(time.time() * 1000)
+        start_mono = time.perf_counter()
         rungs: list[dict] = []
         attempt_errors: list[str] = []
+        # memory high-water sampling rides with tracing (observability is
+        # opt-in; an untraced run pays no sampler thread)
+        sampler = MemorySampler() if self.tracer is not None else None
         try:
+            if sampler is not None:
+                sampler.__enter__()
             err = self._attempt(fn, args, timeout)
             while err is not None:
                 attempt_errors.append(err)
@@ -240,6 +283,11 @@ class BenchReport:
                 if detail:
                     entry.update(detail)
                 rungs.append(entry)
+                if self.tracer is not None:
+                    self.tracer.emit(
+                        "ladder_rung", query=name, rung=rung,
+                        failure_kind=kind, **(detail or {}),
+                    )
                 err = self._attempt(fn, args, timeout)
             if err is not None and faults.classify(err) == faults.DEVICE_OOM:
                 # terminal OOM: drop caches once more so the failure cannot
@@ -248,6 +296,8 @@ class BenchReport:
                 if hasattr(self.session, "recover_memory"):
                     self.session.recover_memory("device memory exhausted")
         finally:
+            if sampler is not None:
+                sampler.__exit__(None, None, None)
             if registered:
                 self.session.unregister_listener(failures.append)
         end_time = int(time.time() * 1000)
@@ -271,6 +321,27 @@ class BenchReport:
         self.summary["queryTimes"].append(end_time - start_time)
         if failures:
             self.summary["taskFailures"] = list(failures)
+        if sampler is not None and sampler.peak_bytes is not None:
+            self.summary["memoryHighWater"] = {
+                "bytes": sampler.peak_bytes,
+                "source": sampler.source,
+            }
+        if self.tracer is not None:
+            ev = {
+                "query": name,
+                # monotonic duration: the epoch-ms queryTimes contract
+                # stays, but the span (which operator spans are checked
+                # against) must not jump with wall-clock adjustments
+                "dur_ms": round((time.perf_counter() - start_mono) * 1000, 3),
+                "status": self.summary["queryStatus"][-1],
+                "retries": len(rungs),
+            }
+            if err is not None:
+                ev["failure_kind"] = self.summary["failureKind"]
+            if sampler is not None and sampler.peak_bytes is not None:
+                ev["mem_hw_bytes"] = sampler.peak_bytes
+                ev["mem_source"] = sampler.source
+            self.tracer.emit("query_span", **ev)
         return self.summary
 
     def write_summary(self, query_name: str, prefix: str = "") -> str:

@@ -20,7 +20,7 @@ import os
 import threading
 import time
 
-from .power import gen_sql_from_stream, run_query_stream
+from .power import gen_sql_from_stream, load_properties, run_query_stream
 
 
 def round_up_to_nearest_10_percent(num: float) -> float:
@@ -223,15 +223,77 @@ def stream_wait_budget(query_timeout=None, n_queries: int = 103):
     return None
 
 
+def _fold_child_streams(tracer, trace_dir, pre_existing, procs):
+    """Fold the event files the child-stream processes wrote into the
+    parent's own event log: one `child_stream` summary event per stream,
+    plus a best-effort failure classification per stream (the parent only
+    sees an exit code; the child's events say WHY it died). Returns
+    {stream_num: failure_kind} for streams whose events record a failure."""
+    from .obs import reader as obs_reader
+
+    kinds = {}
+    new = [
+        f
+        for f in obs_reader.discover_event_files(trace_dir)
+        if f not in pre_existing
+    ]
+    for n, (p, _logf) in sorted(procs.items()):
+        # the child's app id embeds its pid (events-nds-tpu-<pid>-...)
+        mine = [f for f in new if f"-{p.pid}-" in os.path.basename(f)]
+        if not mine:
+            continue
+        try:
+            events = obs_reader.read_events(mine, strict=False)
+        except OSError as exc:
+            # observability must never take the benchmark down: an
+            # unreadable child file still leaves a fold-in marker
+            tracer.emit(
+                "child_stream", stream=n,
+                files=[os.path.basename(f) for f in mine],
+                queries=0, completed=0, failed={}, failure_kinds=[],
+                error=str(exc)[:200],
+            )
+            continue
+        s = obs_reader.summarize_stream(events)
+        tracer.emit(
+            "child_stream",
+            stream=n,
+            files=[os.path.basename(f) for f in mine],
+            queries=s["queries"],
+            completed=s["completed"],
+            failed=s["failed"],
+            failure_kinds=s["failure_kinds"],
+        )
+        k = obs_reader.failure_kind_from_events(events)
+        if k is not None:
+            kinds[n] = k
+    return kinds
+
+
 def _run_throughput_processes(
     input_prefix, stream_paths, time_log_base, input_format, use_decimal,
     property_file, json_summary_folder, output_path, output_format,
     sub_queries=None, query_timeout=None,
 ):
-    """One `nds_tpu.cli.power` subprocess per stream, all concurrent."""
+    """One `nds_tpu.cli.power` subprocess per stream, all concurrent.
+
+    With NDS_TRACE_DIR set each child writes its own event file; the
+    parent discovers them afterwards, folds per-stream summaries into its
+    own event log, and uses the child's events to classify a nonzero exit
+    (the ROADMAP "classify subprocess phase failures from their logs" gap)."""
     import subprocess
     import sys
 
+    from .obs import reader as obs_reader
+    from .obs import trace as obs_trace
+
+    # resolve the trace dir the way the children will (conf tier from the
+    # property file, env fallback): a conf-only engine.trace_dir must not
+    # silently disable the parent's fold-in/classification half
+    conf = load_properties(property_file) if property_file else None
+    trace_dir = obs_trace.resolve_trace_dir(conf)
+    tracer = obs_trace.tracer_from_conf(conf)
+    pre_existing = set(obs_reader.discover_event_files(trace_dir))
     procs = {}
     failures = {}
     try:
@@ -299,6 +361,19 @@ def _run_throughput_processes(
                 p.kill()
                 p.wait()
             logf.close()
+    if tracer is not None:
+        try:
+            child_kinds = _fold_child_streams(
+                tracer, trace_dir, pre_existing, procs
+            )
+            for n, kind in child_kinds.items():
+                if n in failures:
+                    failures[n] = (
+                        f"[classified {kind} from the stream's event log] "
+                        f"{failures[n]}"
+                    )
+        finally:
+            tracer.close()
     if failures:
         raise RuntimeError(f"throughput stream processes failed: {failures}")
     return _ttt_from_logs(stream_paths, time_log_base)

@@ -1,0 +1,672 @@
+"""Observability subsystem: the structured event log (golden schema +
+nesting invariants), memory high-water sampling, child-stream fold-in /
+subprocess failure classification, and the operator-level profiler CLI.
+
+The event schema is a CONTRACT (nds_tpu/obs/trace.py:EVENT_SCHEMA): the
+profiler, the throughput parent's fold-in, and full_bench's phase-failure
+classification all parse these files, so every kind's required fields are
+asserted here against events produced by the real emission sites."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from nds_tpu import faults
+from nds_tpu import full_bench as FB
+from nds_tpu import throughput as TP
+from nds_tpu.cli import profile as profile_cli
+from nds_tpu.engine.session import Session
+from nds_tpu.obs import reader as R
+from nds_tpu.obs.memwatch import MemorySampler
+from nds_tpu.obs.trace import EVENT_SCHEMA, Tracer, bind, tracer_from_conf
+from nds_tpu.report import BenchReport
+
+DATA = "/tmp/nds_test_sf001"
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    monkeypatch.delenv("NDS_TRACE_DIR", raising=False)
+    monkeypatch.delenv("NDS_FAULT_SPEC", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _events(path_or_dir):
+    return R.read_events(path_or_dir, strict=True)
+
+
+def _traced_session(tmp_path, **conf):
+    conf = {"engine.trace_dir": str(tmp_path / "trace"), **conf}
+    s = Session(conf=conf)
+    s.register_arrow(
+        "t",
+        pa.table({"a": [1, 2, 3, 4, 2, 1], "b": [10, 20, 30, 40, 50, 60]}),
+    )
+    return s
+
+
+# ---------------------------------------------------------------------------
+# tracer core
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_disabled_by_default():
+    s = Session()
+    assert s.tracer is None
+    assert tracer_from_conf({}) is None
+
+
+def test_tracer_writes_meta_and_appends(tmp_path):
+    tr = tracer_from_conf({"engine.trace_dir": str(tmp_path)})
+    tr.emit("io_retry", path="/x", error="e", delay_s=0.0)
+    tr.close()
+    evs = _events(tr.path)
+    assert [e["kind"] for e in evs] == ["trace_meta", "io_retry"]
+    assert evs[0]["pid"] == os.getpid()
+    assert all("ts" in e and e["app"] == tr.app_id for e in evs)
+
+
+def test_tracer_auto_scopes_query(tmp_path):
+    tr = tracer_from_conf({"engine.trace_dir": str(tmp_path)})
+    with faults.scope("query42"):
+        tr.emit("plan_cache", node="Aggregate", hit=True)
+    tr.emit("plan_cache", node="Aggregate", hit=False)
+    evs = _events(tr.path)
+    assert evs[1]["query"] == "query42"
+    assert "query" not in evs[2]
+
+
+def test_memory_tracer_collects_in_process():
+    tr = Tracer()  # no dir: in-memory (tools/trace_query.py mode)
+    tr.emit("plan_cache", node="Distinct", hit=False)
+    assert tr.path is None
+    assert [e["kind"] for e in tr.events] == ["plan_cache"]
+
+
+def test_tracer_thread_binding():
+    tr = Tracer()
+    seen = {}
+
+    def worker():
+        from nds_tpu.obs.trace import current
+
+        seen["inner"] = current()
+
+    with bind(tr):
+        from nds_tpu.obs.trace import current
+
+        assert current() is tr
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    from nds_tpu.obs.trace import current
+
+    assert current() is None
+    assert seen["inner"] is None  # thread-locals do not inherit
+
+
+# ---------------------------------------------------------------------------
+# golden schema + engine emission sites
+# ---------------------------------------------------------------------------
+
+
+def test_engine_events_golden_schema(tmp_path):
+    s = _traced_session(tmp_path)
+    with bind(s.tracer):
+        with faults.scope("q_agg"):
+            s.sql("select a, sum(b) sb from t group by a order by a").collect()
+        with faults.scope("q_agg2"):  # plan-cache hit on the aggregate
+            s.sql("select a, sum(b) sb from t group by a order by a").collect()
+        with faults.scope("q_scan"):  # catalog cache hit (columns resident)
+            s.sql("select a, b from t").collect()
+    evs = _events(s.tracer.path)
+    assert R.validate_events(evs) == []
+    kinds = {e["kind"] for e in evs}
+    assert {"trace_meta", "op_span", "catalog_load", "plan_cache"} <= kinds
+    # plan cache: one miss (first aggregate) then one hit (second)
+    pc = [e for e in evs if e["kind"] == "plan_cache"]
+    assert [e["hit"] for e in pc] == [False, True]
+    # catalog: first load is a miss, the later full-resident load is a hit
+    cl = [e for e in evs if e["kind"] == "catalog_load"]
+    assert cl[0]["cache"] == "miss" and cl[-1]["cache"] == "hit"
+    assert all(e["table"] == "t" for e in cl)
+    # op spans carry rows + bytes and are query-scoped
+    ops = [e for e in evs if e["kind"] == "op_span"]
+    assert all(e["query"].startswith("q_") for e in ops)
+    assert any(e["rows"] is not None and e["rows"] > 0 for e in ops)
+    assert all(e["est_bytes"] >= 0 for e in ops)
+
+
+def test_op_span_nesting_invariants(tmp_path):
+    s = _traced_session(tmp_path)
+    with faults.scope("q"):
+        s.sql(
+            "select a, sum(b) sb from t where b > 10 group by a order by a"
+        ).collect()
+    ops = [e for e in _events(s.tracer.path) if e["kind"] == "op_span"]
+    by_exec = {}
+    for e in ops:
+        by_exec.setdefault(e["exec_id"], []).append(e)
+    for spans in by_exec.values():
+        spans.sort(key=lambda e: e["seq"])
+        # seq is 1..n with no gaps; completion (post-) order means a parent
+        # at depth d completes after its depth-d+1 children
+        assert [e["seq"] for e in spans] == list(range(1, len(spans) + 1))
+        assert spans[-1]["depth"] == 0  # the root completes last
+        acc = {}
+        for e in spans:
+            d = e["depth"]
+            child_ms = acc.pop(d + 1, 0.0)
+            # inclusive timing: a parent's span covers its children
+            assert e["dur_ms"] >= child_ms - 1e-6
+            acc[d] = acc.get(d, 0.0) + e["dur_ms"]
+        # nothing left dangling deeper than the root
+        assert set(acc) == {0}
+    withx = R.op_spans_with_exclusive(ops)
+    assert all(e["excl_ms"] >= 0 for e in withx)
+    # exclusive sums to the root inclusive time per executor
+    for eid, spans in by_exec.items():
+        root = max(e["dur_ms"] for e in spans if e["depth"] == 0)
+        tot_excl = sum(
+            e["excl_ms"] for e in withx if e["exec_id"] == eid
+        )
+        roots = sum(
+            e["dur_ms"] for e in spans if e["depth"] == 0
+        )
+        assert abs(tot_excl - roots) < 1e-3
+
+
+def test_blocked_union_event(tmp_path):
+    s = _traced_session(tmp_path)
+    rng = np.random.default_rng(7)
+    for t in ("u1", "u2"):
+        s.register_arrow(
+            t,
+            pa.table({
+                "k": pa.array(rng.integers(1, 5, 3000), pa.int32()),
+                "v": pa.array(rng.integers(-50, 50, 3000), pa.int32()),
+            }),
+        )
+    s.conf["engine.union_agg_window_rows"] = 512
+    with faults.scope("q_union"):
+        s.sql(
+            "select k, sum(v) sv from (select k, v from u1 union all "
+            "select k, v from u2) u group by k order by k"
+        ).collect()
+    evs = _events(s.tracer.path)
+    assert R.validate_events(evs) == []
+    bu = [e for e in evs if e["kind"] == "blocked_union"]
+    assert bu and bu[0]["windows"] > 1 and bu[0]["window_rows"] == 512
+    assert bu[0]["total_rows"] == 6000
+    assert bu[0]["query"] == "q_union"
+
+
+def test_report_events_ladder_fault_and_query_span(tmp_path):
+    s = _traced_session(tmp_path)
+    faults.install("oom:q_flaky:1")
+    with bind(s.tracer):
+        def fn():
+            faults.maybe_fire("q_flaky")
+
+        summary = BenchReport(s).report_on(fn, retry_oom=True, name="q_flaky")
+    assert summary["queryStatus"] == ["CompletedWithTaskFailures"]
+    # engineConf/engineVersion aliases mirror the spark-named compat keys
+    assert summary["env"]["engineConf"] == summary["env"]["sparkConf"]
+    assert summary["env"]["engineVersion"] == summary["env"]["sparkVersion"]
+    assert summary["memoryHighWater"]["bytes"] > 0
+    assert summary["memoryHighWater"]["source"] in ("device", "rss")
+    evs = _events(s.tracer.path)
+    assert R.validate_events(evs) == []
+    fi = [e for e in evs if e["kind"] == "fault_injected"]
+    assert fi and fi[0]["site"] == "q_flaky" and fi[0]["fault_kind"] == "oom"
+    lr = [e for e in evs if e["kind"] == "ladder_rung"]
+    assert [e["rung"] for e in lr] == ["recover_retry"]
+    assert lr[0]["failure_kind"] == faults.DEVICE_OOM
+    qs = [e for e in evs if e["kind"] == "query_span"]
+    assert qs[-1]["query"] == "q_flaky"
+    assert qs[-1]["status"] == "CompletedWithTaskFailures"
+    assert qs[-1]["retries"] == 1
+    assert qs[-1]["mem_hw_bytes"] == summary["memoryHighWater"]["bytes"]
+
+
+def test_watchdog_fire_event(tmp_path):
+    s = _traced_session(tmp_path, **{"engine.query_timeout": 0.3})
+
+    def hang():
+        time.sleep(5)
+
+    summary = BenchReport(s).report_on(hang, name="q_hang")
+    assert summary["failureKind"] == faults.TIMEOUT
+    evs = _events(s.tracer.path)
+    wf = [e for e in evs if e["kind"] == "watchdog_fire"]
+    assert wf and wf[0]["query"] == "q_hang" and wf[0]["budget_s"] == 0.3
+    qs = [e for e in evs if e["kind"] == "query_span"]
+    assert qs[-1]["status"] == "Failed"
+    assert qs[-1]["failure_kind"] == faults.TIMEOUT
+
+
+def test_io_retry_event(tmp_path, monkeypatch):
+    import fsspec
+
+    from nds_tpu.io.fs import fs_open
+
+    monkeypatch.setenv("NDS_IO_BACKOFF", "0")
+    monkeypatch.setenv("NDS_IO_RETRIES", "2")
+    fs = fsspec.filesystem("memory")
+    with fs.open("/obs_retry/data.txt", "w") as f:
+        f.write("payload")
+    faults.install("io:obs_retry:1")
+    tr = Tracer()
+    with bind(tr):
+        with fs_open("memory://obs_retry/data.txt") as f:
+            assert f.read() == "payload"
+    io_evs = [e for e in tr.events if e["kind"] == "io_retry"]
+    assert len(io_evs) == 1
+    assert "obs_retry" in io_evs[0]["path"]
+    assert "transient io" in io_evs[0]["error"]
+
+
+def test_memwatch_sampler_reads_a_peak():
+    with MemorySampler(interval_s=0.005) as ms:
+        _ = [0] * 100000
+        time.sleep(0.03)
+    assert ms.peak_bytes is not None and ms.peak_bytes > 0
+    assert ms.source in ("device", "rss")
+
+
+# ---------------------------------------------------------------------------
+# reader: parsing contracts + fold-in + failure classification
+# ---------------------------------------------------------------------------
+
+
+def _write_jsonl(path, events, torn_tail=None):
+    with open(path, "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+        if torn_tail is not None:
+            f.write(torn_tail)  # no newline: a crash mid-write
+
+
+def _ev(kind, **kw):
+    base = {"ts": 1, "kind": kind, "app": "app-x"}
+    base.update(kw)
+    return base
+
+
+def test_iter_events_tolerates_torn_final_line_only(tmp_path):
+    p = tmp_path / "events-a.jsonl"
+    _write_jsonl(
+        p, [_ev("trace_meta", pid=1, version="0")], torn_tail='{"ts": 2, "ki'
+    )
+    assert len(list(R.iter_events(p, strict=True))) == 1
+    # a malformed MIDDLE line is corruption, not a crash artifact
+    with open(p, "a") as f:
+        f.write("\n{broken}\n" + json.dumps(_ev("plan_cache", node="x", hit=True)) + "\n")
+    with pytest.raises(R.MalformedEventError):
+        list(R.iter_events(p, strict=True))
+    assert len(list(R.iter_events(p, strict=False))) >= 1
+
+
+def test_validate_events_flags_missing_fields():
+    ok = _ev("query_span", query="q1", dur_ms=1.0, status="Completed",
+             retries=0)
+    bad = _ev("query_span", query="q1")
+    unknown = _ev("not_a_kind")
+    probs = R.validate_events([ok, bad, unknown])
+    assert len(probs) == 2
+    assert "missing fields" in probs[0] and "unknown kind" in probs[1]
+    assert set(EVENT_SCHEMA) >= {"op_span", "query_span", "child_stream"}
+
+
+def test_failure_kind_from_events_prefers_failed_query_span():
+    evs = [
+        _ev("query_span", query="q1", dur_ms=1, status="Completed", retries=0),
+        _ev("fault_injected", site="q2", fault_kind="io"),
+        _ev("query_span", query="q2", dur_ms=1, status="Failed", retries=0,
+            failure_kind=faults.DEVICE_OOM),
+    ]
+    assert R.failure_kind_from_events(evs) == faults.DEVICE_OOM
+    # no failed span: the last injected fault's mapped kind
+    assert (
+        R.failure_kind_from_events(evs[:2]) == faults.IO_TRANSIENT
+    )
+    assert R.failure_kind_from_events([]) is None
+    # a recorded query failure BEATS a later (recovered) injected fault
+    evs2 = [
+        _ev("query_span", query="q3", dur_ms=1, status="Failed", retries=0,
+            failure_kind=faults.PLANNER),
+        _ev("fault_injected", site="q4", fault_kind="io"),
+        _ev("query_span", query="q4", dur_ms=1, status="Completed",
+            retries=1),
+    ]
+    assert R.failure_kind_from_events(evs2) == faults.PLANNER
+
+
+def test_profile_multi_stream_sums_per_query(tmp_path):
+    """Profiling several streams' files together (a throughput trace dir)
+    must SUM per query name — not mix one stream's wall with all streams'
+    operator times — and a single failed run marks the query Failed."""
+    d = tmp_path / "tt"
+    d.mkdir()
+    for app, status, mem in (("s1", "Completed", 500), ("s2", "Failed", 900)):
+        _write_jsonl(d / f"events-{app}.jsonl", [
+            _ev("op_span", app=app, query="query1", exec_id=1, seq=1,
+                depth=0, node="Aggregate", explain="Aggregate",
+                dur_ms=100.0, rows=5, est_bytes=40),
+            _ev("query_span", app=app, query="query1", dur_ms=120.0,
+                status=status, retries=0, mem_hw_bytes=mem,
+                mem_source="rss",
+                **({"failure_kind": faults.DEVICE_OOM}
+                   if status == "Failed" else {})),
+        ])
+    prof = R.profile_events(R.read_events(str(d)))
+    q1 = prof["queries"]["query1"]
+    assert q1["runs"] == 2
+    assert q1["wall_ms"] == 240.0  # summed across streams
+    assert q1["root_incl_ms"] == 200.0  # plan time stays <= wall time
+    assert q1["root_incl_ms"] <= q1["wall_ms"]
+    assert q1["status"] == "Failed"  # any failed run surfaces
+    assert q1["failure_kind"] == faults.DEVICE_OOM
+    assert q1["mem_hw_bytes"] == 900  # max, not last-wins
+
+
+def test_fold_child_streams_emits_summary_and_classifies(tmp_path):
+    trace_dir = tmp_path / "trace"
+    trace_dir.mkdir()
+    pid = 54321
+    child = trace_dir / f"events-nds-tpu-{pid}-1-abc.jsonl"
+    _write_jsonl(child, [
+        _ev("trace_meta", pid=pid, version="0"),
+        _ev("query_span", query="query1", dur_ms=5, status="Completed",
+            retries=0),
+        _ev("query_span", query="query5", dur_ms=9, status="Failed",
+            retries=2, failure_kind=faults.DEVICE_OOM),
+    ], torn_tail='{"torn')
+
+    class FakeProc:
+        def __init__(self, pid):
+            self.pid = pid
+
+    parent = Tracer()
+    kinds = TP._fold_child_streams(
+        parent, str(trace_dir), pre_existing=set(),
+        procs={3: (FakeProc(pid), None)},
+    )
+    assert kinds == {3: faults.DEVICE_OOM}
+    cs = [e for e in parent.events if e["kind"] == "child_stream"]
+    assert len(cs) == 1
+    assert cs[0]["stream"] == 3
+    assert cs[0]["queries"] == 2 and cs[0]["completed"] == 1
+    assert cs[0]["failed"] == {"query5": faults.DEVICE_OOM}
+    assert R.validate_events(cs) == []
+
+
+def test_phase_failure_classified_from_child_events(tmp_path, monkeypatch):
+    trace_dir = tmp_path / "trace"
+    trace_dir.mkdir()
+    monkeypatch.setenv("NDS_TRACE_DIR", str(trace_dir))
+    monkeypatch.setenv("NDS_PHASE_RETRIES", "1")
+    monkeypatch.setenv("NDS_PHASE_BACKOFF", "0")
+    state = FB.BenchState(str(tmp_path / "state.json"), "fp")
+    calls = {"n": 0}
+
+    def phase_fn():
+        calls["n"] += 1
+        # simulate a child process that wrote events then died opaquely
+        _write_jsonl(
+            trace_dir / f"events-nds-tpu-99-{calls['n']}-x.jsonl",
+            [_ev("query_span", query="q", dur_ms=1, status="Failed",
+                 retries=0, failure_kind=faults.IO_TRANSIENT)],
+        )
+        if calls["n"] == 1:
+            raise subprocess.CalledProcessError(1, ["child"])  # opaque
+
+    tracer = Tracer()
+    FB._run_phase(state, "power_test", None, phase_fn, tracer=tracer)
+    # opaque exit reclassified io_transient from the child's events -> retried
+    assert calls["n"] == 2
+    assert state.is_done("power_test")
+    ph = [e for e in tracer.events if e["kind"] == "phase"]
+    assert [e["event"] for e in ph] == ["begin", "end"]
+    assert ph[-1]["status"] == "ok" and ph[-1]["attempts"] == 2
+    assert R.validate_events(ph) == []
+
+
+def test_phase_deterministic_failure_still_fails_fast(tmp_path, monkeypatch):
+    monkeypatch.setenv("NDS_PHASE_RETRIES", "3")
+    monkeypatch.setenv("NDS_PHASE_BACKOFF", "0")
+    state = FB.BenchState(str(tmp_path / "state.json"), "fp")
+    calls = {"n": 0}
+
+    def phase_fn():
+        calls["n"] += 1
+        raise ValueError("ExecError: deterministic")
+
+    tracer = Tracer()
+    with pytest.raises(FB.PhaseError):
+        FB._run_phase(state, "load_test", None, phase_fn, tracer=tracer)
+    assert calls["n"] == 1
+    ph = [e for e in tracer.events if e["kind"] == "phase"]
+    assert ph[-1]["status"] == "failed"
+    assert ph[-1]["failure_kind"] == faults.PLANNER
+
+
+# ---------------------------------------------------------------------------
+# profiler: aggregation + A/B compare + CLI
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_run(tmp_path, name, scale=1.0, fail_q2=False):
+    d = tmp_path / name
+    d.mkdir()
+    spans = [
+        _ev("trace_meta", pid=1, version="0"),
+        _ev("op_span", query="query1", exec_id=1, seq=1, depth=1,
+            node="Scan", explain="Scan t", dur_ms=40 * scale, rows=100,
+            est_bytes=800),
+        _ev("op_span", query="query1", exec_id=1, seq=2, depth=1,
+            node="MultiJoin", explain="MultiJoin", dur_ms=100 * scale,
+            rows=50, est_bytes=400),
+        _ev("op_span", query="query1", exec_id=1, seq=3, depth=0,
+            node="Aggregate", explain="Aggregate", dur_ms=200 * scale,
+            rows=5, est_bytes=40),
+        _ev("query_span", query="query1", dur_ms=250 * scale,
+            status="Completed", retries=0, mem_hw_bytes=1000,
+            mem_source="rss"),
+        _ev("catalog_load", table="t", columns=2, loaded=2, rows=100,
+            dur_ms=3.0, cache="miss"),
+        _ev("catalog_load", table="t", columns=2, loaded=0, rows=100,
+            dur_ms=0.1, cache="hit"),
+        _ev("plan_cache", node="Aggregate", hit=False),
+    ]
+    if fail_q2:
+        spans.append(
+            _ev("query_span", query="query2", dur_ms=10, status="Failed",
+                retries=1, failure_kind=faults.DEVICE_OOM)
+        )
+    else:
+        spans.append(
+            _ev("query_span", query="query2", dur_ms=80, status="Completed",
+                retries=0)
+        )
+    _write_jsonl(d / "events-run.jsonl", spans)
+    return d
+
+
+def test_profile_aggregation_and_exclusive_time(tmp_path):
+    d = _synthetic_run(tmp_path, "run")
+    prof = R.profile_events(R.read_events(str(d)))
+    q1 = prof["queries"]["query1"]
+    assert q1["wall_ms"] == 250.0
+    assert q1["root_incl_ms"] == 200.0  # root span <= recorded wall
+    assert q1["root_incl_ms"] <= q1["wall_ms"]
+    # Aggregate exclusive = 200 - (40 + 100) children
+    assert q1["ops"]["Aggregate"]["excl_ms"] == pytest.approx(60.0)
+    assert q1["ops"]["Scan"]["rows"] == 100
+    assert q1["mem_hw_bytes"] == 1000
+    assert prof["op_totals"]["MultiJoin"]["excl_ms"] == pytest.approx(100.0)
+    t = prof["tallies"]
+    assert t["catalog_loads"] == 2 and t["catalog_cache_hits"] == 1
+    assert t["plan_cache_misses"] == 1
+
+
+def test_profile_compare_flags_regressions(tmp_path):
+    old = _synthetic_run(tmp_path, "old", scale=1.0)
+    new = _synthetic_run(tmp_path, "new", scale=3.0, fail_q2=True)
+    regs = R.compare_profiles(
+        R.profile_events(R.read_events(str(old))),
+        R.profile_events(R.read_events(str(new))),
+        ratio=1.25, min_ms=50.0,
+    )
+    changes = {(r["level"], r.get("node"), r["query"]): r for r in regs}
+    assert ("query", None, "query1") in changes
+    assert changes[("query", None, "query1")]["ratio"] == pytest.approx(3.0)
+    assert ("operator", "Aggregate", "query1") in changes
+    q2 = [r for r in regs if r["query"] == "query2"]
+    assert q2 and q2[0]["change"] == "status_change"
+    # identical runs: clean
+    assert R.compare_profiles(
+        R.profile_events(R.read_events(str(old))),
+        R.profile_events(R.read_events(str(old))),
+    ) == []
+
+
+def test_profile_cli_renders_and_compares(tmp_path, capsys):
+    old = _synthetic_run(tmp_path, "old", scale=1.0)
+    new = _synthetic_run(tmp_path, "new", scale=3.0)
+    profile_cli.main([str(old), "--per_query"])
+    out = capsys.readouterr().out
+    assert "query1" in out and "Aggregate" in out and "top" in out
+    assert "catalog 2 loads (1 cache-hit)" in out
+    profile_cli.main(["--compare", str(old), str(new)])
+    out = capsys.readouterr().out
+    assert "regression" in out and "query1" in out
+    with pytest.raises(SystemExit) as exc:
+        profile_cli.main([
+            "--compare", str(old), str(new), "--fail_on_regression",
+        ])
+    assert exc.value.code == 1
+
+
+def test_profile_cli_fails_on_malformed_log(tmp_path, capsys):
+    d = tmp_path / "bad"
+    d.mkdir()
+    (d / "events-x.jsonl").write_text('{"ts": 1}\n{broken}\n{"ts": 2}\n')
+    with pytest.raises(SystemExit) as exc:
+        profile_cli.main([str(d)])
+    assert exc.value.code == 2
+
+
+def test_profile_cli_check_flags_schema_problems(tmp_path):
+    d = tmp_path / "odd"
+    d.mkdir()
+    _write_jsonl(d / "events-x.jsonl", [_ev("not_a_kind")])
+    with pytest.raises(SystemExit) as exc:
+        profile_cli.main([str(d), "--check"])
+    assert exc.value.code == 2
+    profile_cli.main([str(d)])  # without --check: warn only
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: a traced power run over real (tiny) data + the profiler CLI
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    if not os.path.exists(os.path.join(DATA, ".complete")):
+        subprocess.run(
+            [sys.executable, "-m", "nds_tpu.cli.gen_data", "--scale", "0.01",
+             "--parallel", "2", "--data_dir", DATA, "--overwrite_output"],
+            check=True, capture_output=True, cwd=REPO,
+        )
+        open(os.path.join(DATA, ".complete"), "w").close()
+    mini = tmp_path_factory.mktemp("mini_wh")
+    for t in ("store_sales", "date_dim"):
+        os.symlink(os.path.join(DATA, t), mini / t)
+    return str(mini)
+
+
+STREAM = """-- start query 1 in stream 0 using template query96.tpl
+select count(*) cnt from store_sales where ss_quantity > 0
+;
+-- end query 1 in stream 0 using template query96.tpl
+
+-- start query 2 in stream 0 using template query3.tpl
+select d_year, count(*) c from date_dim group by d_year order by d_year limit 5
+;
+-- end query 2 in stream 0 using template query3.tpl
+
+-- start query 3 in stream 0 using template query42.tpl
+select d_moy, sum(ss_ext_sales_price) s from store_sales, date_dim
+where ss_sold_date_sk = d_date_sk and d_year = 2000
+group by d_moy order by d_moy
+;
+-- end query 3 in stream 0 using template query42.tpl
+
+-- start query 4 in stream 0 using template query55.tpl
+select d_year, count(*) c from date_dim where d_moy = 11
+group by d_year order by d_year limit 5
+;
+-- end query 4 in stream 0 using template query55.tpl
+"""
+
+
+@pytest.mark.slow
+def test_traced_power_run_end_to_end(data_dir, tmp_path, monkeypatch, capsys):
+    """Acceptance: a traced power run over >= 3 queries produces a parseable
+    event log whose root operator spans fit inside the recorded query wall
+    time, with catalog-load and cache-hit events, and the profiler renders a
+    per-operator breakdown from it."""
+    from nds_tpu.power import gen_sql_from_stream, run_query_stream
+
+    trace_dir = tmp_path / "trace"
+    monkeypatch.setenv("NDS_TRACE_DIR", str(trace_dir))
+    stream = tmp_path / "query_0.sql"
+    stream.write_text(STREAM)
+    run_query_stream(
+        input_prefix=data_dir,
+        property_file=None,
+        query_dict=gen_sql_from_stream(str(stream)),
+        time_log_output_path=str(tmp_path / "time.csv"),
+        input_format="csv",
+        json_summary_folder=str(tmp_path / "json"),
+    )
+    files = R.discover_event_files(str(trace_dir))
+    assert len(files) == 1
+    evs = R.read_events(files, strict=True)  # parseable, line by line
+    assert R.validate_events(evs) == []
+    kinds = {e["kind"] for e in evs}
+    assert {"op_span", "query_span", "catalog_load"} <= kinds
+    assert any(
+        e["kind"] == "catalog_load" and e["cache"] == "hit" for e in evs
+    ), "repeated table loads must produce a cache-hit event"
+    prof = R.profile_events(evs)
+    assert set(prof["queries"]) == {"query96", "query3", "query42", "query55"}
+    for q, rec in prof["queries"].items():
+        assert rec["status"] == "Completed"
+        assert rec["ops"], f"{q}: no operator spans"
+        # inclusive root operator time fits inside the recorded wall time
+        assert rec["root_incl_ms"] <= rec["wall_ms"] + 1.0, q
+        assert rec.get("mem_hw_bytes", 0) > 0
+    # every per-query summary carries the memory high-water too
+    jdir = tmp_path / "json"
+    for f in os.listdir(jdir):
+        s = json.load(open(jdir / f))
+        assert s["memoryHighWater"]["bytes"] > 0
+        assert s["env"]["engineConf"] == s["env"]["sparkConf"]
+    # the profiler CLI renders a per-operator breakdown from the real log
+    profile_cli.main([str(trace_dir), "--per_query", "--check"])
+    out = capsys.readouterr().out
+    assert "query42" in out and "Aggregate" in out
+    assert "tallies" in out

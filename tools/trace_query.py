@@ -2,7 +2,9 @@
 
 Usage: python tools/trace_query.py query4 [query14_part2 ...]
 Runs each query twice (cold then traced steady) and prints the slowest
-plan nodes with INCLUSIVE wall time.
+plan nodes with INCLUSIVE wall time, output rows, and estimated output
+bytes — read from the obs subsystem's in-memory tracer (the same op_span
+events `NDS_TRACE_DIR` + `nds_tpu.cli.profile` consume at run scale).
 """
 import os
 import sys
@@ -11,8 +13,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from nds_tpu.engine import exec as X
 from nds_tpu.engine.session import Session
+from nds_tpu.obs.trace import Tracer
 from nds_tpu.schema import get_schemas
 from nds_tpu.datagen.query_streams import generate_streams
 from nds_tpu.power import gen_sql_from_stream
@@ -34,13 +36,19 @@ for qname in sys.argv[1:]:
     r = sess.run_script(queries[qname])  # warm compile caches
     if r is not None:
         r.collect()
-    X.TRACE_NODES = trace = []
+    sess.tracer = tracer = Tracer()  # in-memory mode: events collect in a list
     t0 = time.perf_counter()
     r = sess.run_script(queries[qname])
     if r is not None:
         r.collect()
     total = time.perf_counter() - t0
-    X.TRACE_NODES = None
-    print(f"\n=== {qname}: steady {total:.2f}s, {len(trace)} nodes ===")
-    for secs, typ, desc in sorted(trace, reverse=True)[:18]:
-        print(f"  {secs:7.3f}s  {typ:12s} {desc}")
+    sess.tracer = None
+    spans = [e for e in tracer.events if e["kind"] == "op_span"]
+    print(f"\n=== {qname}: steady {total:.2f}s, {len(spans)} nodes ===")
+    for ev in sorted(spans, key=lambda e: -e["dur_ms"])[:18]:
+        rows = "-" if ev["rows"] is None else f"{ev['rows']:,}"
+        print(
+            f"  {ev['dur_ms'] / 1000:7.3f}s  {ev['node']:12s} "
+            f"rows={rows:>12s}  ~{ev['est_bytes'] / 1e6:8.1f}MB  "
+            f"{ev['explain']}"
+        )
