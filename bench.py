@@ -22,10 +22,14 @@ parse always sees the most complete results even if the process is killed
 mid-run (the round-3 rc=124 timeout recorded nothing because the single
 print sat at the very end).
 
-The OUT object is self-describing (per-run evidence, not just the headline):
-`per_query` {name: {cold, steady}}, `slowest5` [[name, steady]...], and
-`failed` {name: error text} ride along with the geomean so a killed or
-failed run still leaves per-query times and failure reasons in the artifact.
+Every emitted line is COMPACT: headline metrics, geomeans (steady + cold),
+stream wall seconds, the engine-vs-sqlite ratio on the shared query subset,
+failure counts + failed-query names, and the sf10 block — never the
+per-query map (round 5's final line grew to ~1.3 MB of per-query detail and
+the driver's tail window truncated its FRONT, losing the headline:
+VERDICT item 2). Full per-query times and failure texts are written
+atomically to a side file on every update (`detail_file` in the JSON,
+default bench_detail.json next to this script, override NDS_BENCH_DETAIL).
 
 After the SF1 stream, a secondary `sf10` block records the same metrics at
 NDS scale factor 10 (wall-budgeted, fail-soft), and `sqlite_anchor` embeds
@@ -81,7 +85,10 @@ order by d.d_year, sum_agg desc, brand_id
 limit 100
 """
 
-# the one result object, mutated in place and re-printed monotonically
+# the one result object, mutated in place and re-printed monotonically.
+# COMPACT by contract: per-query detail goes to DETAIL (side file), never
+# into an emitted line. NDS_BENCH_EMIT_DETAIL=1 (the SF10 isolation child)
+# folds the detail into every line so the parent can read it from stdout.
 OUT = {
     "metric": "nds_q3_fact_rows_per_sec_per_chip",
     "value": None,
@@ -90,10 +97,51 @@ OUT = {
     "scale_factor": SCALE,
 }
 
+# full per-query evidence: {"per_query": {...}, "failed": {...},
+# "sf10": {"per_query": ..., "failed": ...}} — written to DETAIL_PATH
+DETAIL = {}
+DETAIL_PATH = os.environ.get(
+    "NDS_BENCH_DETAIL",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "bench_detail.json"),
+)
+SQLITE_PER_QUERY = {}  # loaded by load_sqlite_anchor (shared-subset ratio)
+
+
+def _current_out():
+    """The dict an output line carries right now: OUT, plus the folded-in
+    main detail when NDS_BENCH_EMIT_DETAIL is set (the SF10 isolation
+    child's stdout protocol). Shared by emit() and the SIGTERM flush so
+    the two can never drift."""
+    if os.environ.get("NDS_BENCH_EMIT_DETAIL"):
+        out = dict(OUT)
+        out.update(DETAIL.get("main", {}))
+        return out
+    return OUT
+
 
 def emit():
     """Print the current result as one complete JSON line (fail-soft)."""
-    print(json.dumps(OUT), flush=True)
+    print(json.dumps(_current_out()), flush=True)
+
+
+def write_detail():
+    """Atomically persist the per-query detail side file (tmp + rename: a
+    SIGKILL mid-write must not leave a torn artifact)."""
+    if os.environ.get("NDS_BENCH_SF10_CHILD"):
+        # the isolation child reports through stdout (NDS_BENCH_EMIT_DETAIL)
+        # and inherits the parent's DETAIL_PATH: writing here would replace
+        # the parent's SF1 detail with the child's subset mid-run
+        return
+    try:
+        tmp = DETAIL_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(DETAIL, f, indent=1, sort_keys=True)
+        os.replace(tmp, DETAIL_PATH)
+        OUT["detail_file"] = DETAIL_PATH
+    except OSError as exc:
+        # detail is evidence, not the contract: never take the run down
+        print(f"detail side file failed: {exc}", file=sys.stderr)
 
 
 def _on_term(signum, frame):
@@ -104,8 +152,10 @@ def _on_term(signum, frame):
     # geomean loop's except). Raw writes + immediate exit only.
     try:
         # leading newline terminates any half-flushed buffered line so the
-        # final line on stdout is always a complete JSON object
-        os.write(1, ("\n" + json.dumps(OUT) + "\n").encode())
+        # final line on stdout is always a complete JSON object (the
+        # isolation child's detail fold-in rides _current_out, same as
+        # every regular emit)
+        os.write(1, ("\n" + json.dumps(_current_out()) + "\n").encode())
         os.write(2, b"SIGTERM: flushed partial results\n")
     except OSError:
         pass
@@ -287,27 +337,31 @@ def bench_geomean(sess, block=None, scale=None, wall_budget=None):
             return "timeout" if finished_late else "ok"
         return "timeout"
 
+    dbucket = DETAIL.setdefault("main" if block is OUT else "sf10", {})
+
     def update_out():
-        if detail:
-            geo = math.exp(
-                sum(math.log(max(v["steady"], 1e-4)) for v in detail.values())
-                / len(detail)
-            )
-            block["geomean_query_sec"] = round(geo, 4)
-        block["geomean_queries"] = len(detail)
-        block["per_query"] = {
+        _fill_block(block, detail, failed, wall_start)
+        dbucket["per_query"] = {
             n: {"cold": round(v["cold"], 2), "steady": round(v["steady"], 3)}
             for n, v in detail.items()
         }
-        block["slowest5"] = [
-            [n, round(v["steady"], 2)]
-            for n, v in sorted(
-                detail.items(), key=lambda kv: -kv[1]["steady"]
-            )[:5]
-        ]
         if failed:
-            block["failed_queries"] = sorted(failed)
-            block["failed"] = {n: e[:500] for n, e in failed.items()}
+            dbucket["failed"] = {n: e[:500] for n, e in failed.items()}
+        if block is OUT and SQLITE_PER_QUERY and detail:
+            # engine-vs-sqlite on the SHARED subset (queries both engines
+            # completed): the anchor's own geomean excludes its timeouts,
+            # so the headline ratio must compare like with like
+            shared = [n for n in detail if n in SQLITE_PER_QUERY]
+            if shared:
+                eng = _geomean([detail[n]["steady"] for n in shared])
+                sq = _geomean([SQLITE_PER_QUERY[n] for n in shared])
+                OUT["sqlite_shared"] = {
+                    "queries": len(shared),
+                    "engine_geomean_sec": round(eng, 4),
+                    "sqlite_geomean_sec": round(sq, 4),
+                    "ratio": round(eng / sq, 3),
+                }
+        write_detail()
         emit()
 
     for i, (name, q) in enumerate(queries.items()):
@@ -371,6 +425,15 @@ def bench_geomean(sess, block=None, scale=None, wall_budget=None):
                 # further query would burn the run budget failing the same
                 # way.
                 if not meta.get("blocked"):
+                    if os.environ.get("NDS_BENCH_OOM_EXIT"):
+                        # SF10 isolation child: a hard OOM on an unblocked
+                        # plan permanently poisons this backend, so exit
+                        # now (failure already recorded + emitted) and let
+                        # the parent restart a fresh process for the
+                        # remaining queries
+                        block["oom_exit"] = name
+                        emit()
+                        sys.exit(17)
                     consecutive_oom += 1
                     if consecutive_oom >= 3:
                         block["aborted"] = (
@@ -381,6 +444,38 @@ def bench_geomean(sess, block=None, scale=None, wall_budget=None):
                         break
             else:
                 consecutive_oom = 0
+
+
+def _geomean(vals):
+    return math.exp(sum(math.log(max(v, 1e-4)) for v in vals) / len(vals))
+
+
+def _fill_block(block, detail, failed, wall_start):
+    """Compact summary fields for an emitted block: steady + cold geomeans,
+    cold/steady ratio (VERDICT items 4/5: TPC-DS times actual single
+    executions, so cold must be first-class), stream wall clock, failure
+    counts + names — never the per-query map (that goes to DETAIL)."""
+    if detail:
+        block["geomean_query_sec"] = round(
+            _geomean([v["steady"] for v in detail.values()]), 4
+        )
+        block["cold_geomean_query_sec"] = round(
+            _geomean([v["cold"] for v in detail.values()]), 4
+        )
+        block["cold_vs_steady"] = round(
+            block["cold_geomean_query_sec"] / block["geomean_query_sec"], 3
+        )
+        block["slowest5"] = [
+            [n, round(v["steady"], 2)]
+            for n, v in sorted(
+                detail.items(), key=lambda kv: -kv[1]["steady"]
+            )[:5]
+        ]
+    block["geomean_queries"] = len(detail)
+    block["stream_wall_sec"] = round(time.monotonic() - wall_start, 1)
+    if failed:
+        block["failed_queries"] = sorted(failed)
+        block["failed_count"] = len(failed)
 
 
 def load_sqlite_anchor():
@@ -405,9 +500,13 @@ def load_sqlite_anchor():
             "timeout_or_failed", "per_query_budget_s",
         )
     }
+    SQLITE_PER_QUERY.update(a.get("per_query") or {})
 
 
 def main():
+    if os.environ.get("NDS_BENCH_SF10_CHILD"):
+        sf10_child_main()
+        return
     signal.signal(signal.SIGTERM, _on_term)
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     load_sqlite_anchor()
@@ -464,35 +563,179 @@ def _sf10_data_dir() -> str:
     return "/tmp/nds_bench_sf10.0"
 
 
-def bench_sf10(sess_sf1):
-    """Secondary block at SF10 (BASELINE ladder: the next rung after SF1;
-    store_sales = 28.8M rows — fits HBM, stresses every capacity
-    heuristic). Fail-soft into OUT['sf10']. The query loop is wall-
-    budgeted; datagen and the transcode measurement before it are bounded
-    by data size (~15 min on the 1-core host), and a SIGTERM at any point
-    still flushes whatever the block has recorded so far."""
+def _sf10_session(data_dir):
     from nds_tpu.engine.session import Session
     from nds_tpu.schema import get_schemas
 
-    block = OUT.setdefault("sf10", {})
-    data_dir = _sf10_data_dir()
-    ensure_data(scale=10, data_dir=data_dir, parallel=8)
-    block["transcode_rows_per_sec"] = round(bench_transcode(data_dir))
-    emit()
-    # free the SF1 session's device residency before loading SF10 tables
-    sess_sf1.recover_memory("switching to SF10 data")
     sess = Session()
     # SF10 fact caps are 32M rows: a single multi-column pair table is
     # GB-scale, and one hard OOM poisons the backend for the whole rest of
     # the stream (axon terminal). Trade table-reload time for headroom.
     sess.catalog.DEVICE_BUDGET_BYTES = 3 << 30
-    schemas = get_schemas()
-    for t, schema in schemas.items():
+    for t, schema in get_schemas().items():
         path = os.path.join(data_dir, t)
         if os.path.isdir(path):
             sess.register_csv_dir(t, path, schema)
+    return sess
+
+
+def _stream_query_names(scale):
+    """Query names of stream 0 at `scale`, in stream order (the parent
+    needs them to assign work to isolation children and to identify the
+    query a dead child was running)."""
+    import tempfile
+
+    from nds_tpu.datagen.query_streams import generate_streams
+    from nds_tpu.power import gen_sql_from_stream
+
+    with tempfile.TemporaryDirectory() as d:
+        generate_streams(d, 1, scale, rngseed=19620718)
+        return list(gen_sql_from_stream(os.path.join(d, "query_0.sql")))
+
+
+def _last_json_line(text):
+    for line in reversed((text or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+_OOM_EXIT_RC = 17  # child recorded the OOM itself before exiting
+
+
+def sf10_child_main():
+    """Isolation child (NDS_BENCH_SF10_CHILD=1): run the assigned SF10
+    query subset (NDS_BENCH_QUERY_SUBSET) on a fresh backend, emitting
+    fail-soft JSON lines WITH per-query detail (the parent reads them from
+    stdout). Exits 17 after recording an unblocked device OOM so the
+    parent restarts a clean process for the remaining queries."""
+    signal.signal(signal.SIGTERM, _on_term)
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    os.environ["NDS_BENCH_EMIT_DETAIL"] = "1"
+    os.environ["NDS_BENCH_OOM_EXIT"] = "1"
+    sess = _sf10_session(_sf10_data_dir())
+    budget = int(os.environ.get("NDS_BENCH_SF10_WALL_BUDGET", "2700"))
+    bench_geomean(sess, block=OUT, scale=10, wall_budget=budget)
+    emit()
+
+
+def bench_sf10(sess_sf1):
+    """Secondary block at SF10 (BASELINE ladder: the next rung after SF1;
+    store_sales = 28.8M rows — fits HBM, stresses every capacity
+    heuristic). Fail-soft into OUT['sf10'].
+
+    Per-query-failure SUBPROCESS ISOLATION (VERDICT item 8): queries run
+    in a child process; when one dies on a device OOM (or crashes/wedges),
+    only THAT query is recorded as failed and a fresh child continues with
+    the remaining ones — one OOM no longer poisons/aborts the rest of the
+    block. NDS_BENCH_SF10_ISOLATION=inproc restores the single-process
+    path (debug aid). The loop is wall-budgeted; a SIGTERM at any point
+    still flushes whatever the block has recorded so far."""
+    block = OUT.setdefault("sf10", {})
+    data_dir = _sf10_data_dir()
+    ensure_data(scale=10, data_dir=data_dir, parallel=8)
+    block["transcode_rows_per_sec"] = round(bench_transcode(data_dir))
+    emit()
+    # free the SF1 session's device residency before SF10 work starts
+    sess_sf1.recover_memory("switching to SF10 data")
     budget = int(os.environ.get("NDS_BENCH_SF10_BUDGET", "2700"))
-    bench_geomean(sess, block=block, scale=10, wall_budget=budget)
+    if os.environ.get("NDS_BENCH_SF10_ISOLATION", "process") == "inproc":
+        bench_geomean(
+            _sf10_session(data_dir), block=block, scale=10,
+            wall_budget=budget,
+        )
+        return
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    names = _stream_query_names(scale=10)
+    subset = os.environ.get("NDS_BENCH_QUERY_SUBSET")
+    if subset:
+        keep = {s.strip() for s in subset.split(",") if s.strip()}
+        names = [n for n in names if n in keep]
+    t_start = time.monotonic()
+    detail = {}  # name -> {"cold", "steady"} (floats, parent-side)
+    failed = {}
+    dbucket = DETAIL.setdefault("sf10", {})
+
+    def update_block():
+        _fill_block(block, detail, failed, t_start)
+        dbucket["per_query"] = dict(detail)
+        if failed:
+            dbucket["failed"] = {n: e[:500] for n, e in failed.items()}
+        write_detail()
+        emit()
+
+    remaining = list(names)
+    while remaining:
+        left = budget - (time.monotonic() - t_start)
+        if left <= 60:
+            block["truncated_after"] = len(names) - len(remaining)
+            update_block()
+            break
+        env = dict(os.environ)
+        env["NDS_BENCH_SF10_CHILD"] = "1"
+        env["NDS_BENCH_QUERY_SUBSET"] = ",".join(remaining)
+        env["NDS_BENCH_SF10_WALL_BUDGET"] = str(int(left))
+        stderr_tail = ""
+        budget_kill = False
+        try:
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, cwd=here, capture_output=True, text=True,
+                timeout=left + 120,
+            )
+            rc, out_text = p.returncode, p.stdout
+            stderr_tail = (p.stderr or "")[-300:]
+        except subprocess.TimeoutExpired as te:
+            # the parent's own wall budget (plus grace) expired: this is
+            # TRUNCATION, not a query failure — the query the child was on
+            # must not enter `failed` as if it broke
+            rc = -9
+            budget_kill = True
+            out_text = te.stdout or ""
+            if isinstance(out_text, bytes):
+                out_text = out_text.decode("utf-8", "replace")
+            err_text = te.stderr or ""
+            if isinstance(err_text, bytes):
+                err_text = err_text.decode("utf-8", "replace")
+            stderr_tail = err_text[-300:]
+        child = _last_json_line(out_text) or {}
+        cpq = child.get("per_query") or {}
+        cfail = child.get("failed") or {}
+        detail.update(
+            {n: v for n, v in cpq.items() if isinstance(v, dict)}
+        )
+        failed.update(cfail)
+        covered = set(cpq) | set(cfail)
+        new_remaining = [n for n in remaining if n not in covered]
+        progressed = bool(covered & set(remaining))
+        if budget_kill:
+            remaining = new_remaining
+            block["truncated_after"] = len(names) - len(remaining)
+            update_block()
+            break
+        if new_remaining and (
+            not progressed or rc not in (0, _OOM_EXIT_RC)
+        ):
+            # the child died mid-query (or produced nothing): blame the
+            # first query it had not covered, then move past it — without
+            # this the loop could respawn children forever on a
+            # reproducible early crash
+            victim = new_remaining.pop(0)
+            failed[victim] = (
+                f"subprocess died (rc={rc}): {stderr_tail}"
+                if rc != 0
+                else "subprocess made no progress"
+            )
+        remaining = new_remaining
+        update_block()
+        # anything left (child OOM-exit, crash, wedge-abort, or its own
+        # wall-budget stop) loops back: the budget check at the top
+        # decides whether a fresh child continues
 
 
 if __name__ == "__main__":

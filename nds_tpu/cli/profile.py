@@ -95,6 +95,13 @@ def _render_profile(prof, top: int, per_query: bool):
           f"watchdog fires {t['watchdog_fires']}; faults injected "
           f"{t['faults_injected']}; blocked-union windows "
           f"{t['blocked_union_windows']}")
+    rate = R.exec_cache_hit_rate(prof)
+    if rate is not None or t["pipelines_fused"] or t["pipelines_eager"]:
+        rate_s = "-" if rate is None else f"{rate:.1%}"
+        print(f"== pipelines: {t['pipelines_fused']} fused / "
+              f"{t['pipelines_eager']} eager; executable cache "
+              f"{t['exec_cache_hits']} hit / {t['exec_cache_misses']} miss "
+              f"(rate {rate_s})")
 
 
 def _render_compare(regs, ratio, min_ms):
@@ -137,6 +144,12 @@ def main(argv=None):
     parser.add_argument("--check", action="store_true",
                         help="exit 2 on any schema problem (CI gate); "
                         "malformed JSON lines always exit 2")
+    parser.add_argument("--min_exec_cache_hit_rate", type=float,
+                        metavar="RATE",
+                        help="exit 1 when the run's fused-executable cache "
+                        "hit rate is below RATE (or no exec_cache events "
+                        "were recorded at all) — the ci/tier1-check "
+                        "microbench guard")
     parser.add_argument("--ratio", type=float, default=1.25,
                         help="compare: flag when new >= old * ratio (1.25)")
     parser.add_argument("--min_ms", type=float, default=50.0,
@@ -165,6 +178,22 @@ def main(argv=None):
         print(json.dumps(prof, indent=2))
     else:
         _render_profile(prof, args.top, args.per_query)
+    if args.min_exec_cache_hit_rate is not None:
+        rate = R.exec_cache_hit_rate(prof)
+        if rate is None:
+            print(
+                "profile: no exec_cache events recorded (fusion disabled "
+                "or tracing broken) — failing the hit-rate gate",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        if rate < args.min_exec_cache_hit_rate:
+            print(
+                f"profile: executable-cache hit rate {rate:.1%} below the "
+                f"required {args.min_exec_cache_hit_rate:.1%}",
+                file=sys.stderr,
+            )
+            sys.exit(1)
 
 
 if __name__ == "__main__":

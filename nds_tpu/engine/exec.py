@@ -349,7 +349,119 @@ class Executor:
         child = self.execute(node.child)
         return self._masked(child, self._predicate_mask(child, node.predicate))
 
+    # -- fused Filter/Project pipelines -----------------------------------
+    # A Pipeline node (fuse.mark_pipelines) executes its whole chain as ONE
+    # jitted function over the child's device columns: no per-node
+    # dispatch, no materialized intermediates, masks deferred to the
+    # pipeline boundary. Executables are reused across reruns AND across
+    # structurally identical queries via the session ExecutableCache
+    # (keyed on stage fingerprint + dtype signature; jax keys per capacity
+    # bucket underneath). Chains that cannot trace fall back to the exact
+    # eager per-stage path, and the signature is pinned so the build is
+    # attempted once.
+
+    def _exec_pipeline(self, node: P.Pipeline) -> Table:
+        child = self.execute(node.child)
+        session = getattr(self.catalog, "session", None)
+        tracer = self.tracer
+        t0 = _perf() if tracer is not None else 0.0
+        out = None
+        fused = False
+        if (
+            session is not None
+            and session.conf.get("engine.fuse", "on") != "off"
+            and child.columns
+            and child.cap > 0
+        ):
+            from . import fuse as F
+
+            fp = getattr(node, "_stage_fp", None)
+            if fp is None:
+                fp = node._stage_fp = P.fingerprint(
+                    P.Pipeline(stages=node.stages, child=None)
+                )
+            sig = F.input_signature(child)
+            entry, hit = session.exec_cache.lookup(
+                fp, sig, child.cap,
+                lambda: F.FusedPipeline(node.stages, child),
+            )
+            if tracer is not None:
+                tracer.emit(
+                    "exec_cache", pipeline=fp[:12], bucket=child.cap,
+                    hit=hit, fused=entry is not None,
+                )
+            if entry is not None:
+                donate = (
+                    node.donate_ok
+                    and session.conf.get("engine.fuse_donate", "off")
+                    == "on"
+                )
+                try:
+                    out = entry.call(child, donate)
+                    fused = True
+                except Exception as exc:
+                    if donate:
+                        # the failed call may already have donated (and so
+                        # invalidated) the child's live mask — an eager
+                        # retry over those buffers would read garbage;
+                        # surface the failure to the harness ladder instead
+                        raise
+                    # compile/runtime failure on a chain that traced
+                    # abstractly: pin the signature to the eager path
+                    session.exec_cache.map[(fp, sig)] = None
+                    self.on_task_failure(
+                        f"pipeline fuse fallback: {str(exc)[:120]}"
+                    )
+        if out is None:
+            # eager per-stage path (_apply_wrappers wants top-down order)
+            out = self._apply_wrappers(child, list(reversed(node.stages)))
+        if tracer is not None:
+            tracer.emit(
+                "pipeline_span",
+                stages=len(node.stages),
+                fused=fused,
+                dur_ms=round((_perf() - t0) * 1000.0, 3),
+                rows=out.nrows_known,
+            )
+        return out
+
     def _exec_limit(self, node: P.Limit) -> Table:
+        # top-k fusion: ORDER BY .. LIMIT n computes the sort order but
+        # gathers only the first bucket_cap(n) sorted rows per column —
+        # the full-capacity permutation gather of every output column was
+        # pure waste at fact shapes (most TPC-DS queries end in exactly
+        # this shape). Requires the rewrite pass's single-consumer
+        # annotation (fuse.mark_pipelines sets _topk_safe) — a shared
+        # Sort's full result must compute once and serve every consumer —
+        # and falls back when the distributed sort engages (it returns a
+        # fully packed table).
+        if (
+            isinstance(node.child, P.Sort)
+            and getattr(node.child, "_topk_safe", False)
+            and id(node.child) not in self._cte_cache
+        ):
+            sort = node.child
+            child = self._pack_sparse(self.execute(sort.child))
+            if child.nrows_known != 0:
+                words, dist = self._sort_order_words(sort, child)
+                if dist is None:
+                    order = K.sort_by_words(words)
+                    n = min(node.n, child.nrows)
+                    cap = bucket_cap(max(n, 1))
+                    return self._take(child, order[:cap], n)
+                child = dist
+            n = min(node.n, child.nrows)
+            cap = bucket_cap(max(n, 1))
+            child = child.compacted()
+            cols = {
+                name: Column(
+                    c.data[:cap], c.dtype,
+                    None if c.valid is None else c.valid[:cap],
+                    c.dictionary, c.subset_stats(),
+                )
+                for name, c in child.columns.items()
+            }
+            return Table(cols, n)
         child = self.execute(node.child).compacted()
         n = min(node.n, child.nrows)
         cap = bucket_cap(n)
@@ -369,6 +481,16 @@ class Executor:
         child = self._pack_sparse(self.execute(node.child))
         if child.nrows_known == 0:
             return child
+        words, dist = self._sort_order_words(node, child)
+        if dist is not None:
+            return dist
+        order = K.sort_by_words(words)
+        return self._take(child, order, child.nrows_lazy)
+
+    def _sort_order_words(self, node: P.Sort, child: Table):
+        """(sort words, distributed-sort result|None) for a Sort node over
+        its already-executed input — shared by the full sort and the
+        Limit-over-Sort top-k path."""
         ev = self._evaluator(child)
         keys = []
         cols = []
@@ -387,10 +509,7 @@ class Executor:
         dist = self._try_dist_sort(
             child, [(w, None, True, True) for w in words]
         )
-        if dist is not None:
-            return dist
-        order = K.sort_by_words(words)
-        return self._take(child, order, child.nrows_lazy)
+        return words, dist
 
     # -- sort-key word encoding -------------------------------------------
     # Every ordering in the engine (ORDER BY, group-by adjacency, window
@@ -639,7 +758,22 @@ class Executor:
 
     def _exec_multijoin(self, node: P.MultiJoin) -> Table:
         tables = self._execute_relations_batched(node.relations)
-        return self._multijoin_over_tables(tables, node.edges)
+        # join-order replay ACROSS statements: the greedy cost scan reads
+        # joined-intermediate row counts, which is a blocking device->host
+        # sync (~90 ms on the bench tunnel) per join step after the first.
+        # Steady-state reruns and repeated stream queries replay the
+        # recorded order instead (same fingerprint => same query text and
+        # literals, so the recorded order stays the right one; any order
+        # is correct regardless). This recovers the q3 rows/s the round-5
+        # join-graph optimizer cost — see docs/q3_regression.md.
+        trace = None
+        session = getattr(self.catalog, "session", None)
+        if (
+            session is not None
+            and session.conf.get("engine.join_order_cache", "on") != "off"
+        ):
+            trace = session.join_order_cache.setdefault(self._fp(node), {})
+        return self._multijoin_over_tables(tables, node.edges, trace=trace)
 
     def _multijoin_over_tables(self, tables, edges, trace=None) -> Table:
         """Greedy N-way inner join over already-executed relation tables
@@ -1860,8 +1994,9 @@ class Executor:
             rank, sorted_dict = sort_dictionary(c)
             sdata = rank if order is None else rank[order]
             if fn in ("min", "max"):
-                red = K.segment_reduce(sdata, gid, weight, gcap, fn)
-                counts = K.segment_reduce(sdata, gid, weight, gcap, "count")
+                red, counts = K.segment_reduce_with_count(
+                    sdata, gid, weight, gcap, fn
+                )
                 return Column(
                     red.astype(jnp.int32), c.dtype, counts > 0, sorted_dict
                 )
@@ -1885,16 +2020,18 @@ class Executor:
             )
             return Column(s.astype(jnp.float64), c.dtype, n > 0)
         if fn in ("sum", "min", "max"):
-            red = K.segment_reduce(sdata, gid, weight, gcap, fn)
-            counts = K.segment_reduce(sdata, gid, weight, gcap, "count")
+            red, counts = K.segment_reduce_with_count(
+                sdata, gid, weight, gcap, fn
+            )
             dtype = c.dtype
             if fn == "sum" and dtype.kind == "int32":
                 dtype = INT64
                 red = red.astype(jnp.int64)
             return Column(red, dtype, counts > 0)
         if fn == "avg":
-            s = K.segment_reduce(sdata, gid, weight, gcap, "sum")
-            n = K.segment_reduce(sdata, gid, weight, gcap, "count")
+            s, n = K.segment_reduce_with_count(
+                sdata, gid, weight, gcap, "sum"
+            )
             nz = jnp.maximum(n, 1)
             if c.dtype.is_decimal:
                 val = s.astype(jnp.float64) / (10**c.dtype.scale) / nz
@@ -1979,12 +2116,10 @@ class Executor:
             out = K.segment_reduce(vals, gid3, w3, g3cap, "count")
             col = Column(out.astype(jnp.int64), INT64)
         elif agg.fn == "sum":
-            out = K.segment_reduce(vals, gid3, w3, g3cap, "sum")
-            n = K.segment_reduce(vals, gid3, w3, g3cap, "count")
+            out, n = K.segment_reduce_with_count(vals, gid3, w3, g3cap, "sum")
             col = Column(out, c.dtype if c.dtype.kind != "int32" else INT64, n > 0)
         elif agg.fn == "avg":
-            s = K.segment_reduce(vals, gid3, w3, g3cap, "sum")
-            n = K.segment_reduce(vals, gid3, w3, g3cap, "count")
+            s, n = K.segment_reduce_with_count(vals, gid3, w3, g3cap, "sum")
             v = s.astype(jnp.float64) / jnp.maximum(n, 1)
             if c.dtype.is_decimal:
                 v = v / 10**c.dtype.scale
@@ -2101,8 +2236,9 @@ class Executor:
         if whole:
             red_map = {"sum": "sum", "min": "min", "max": "max",
                        "count": "count", "avg": "sum"}
-            red = K.segment_reduce(sdata, gid, w, gcap, red_map[fn])
-            counts = K.segment_reduce(sdata, gid, w, gcap, "count")
+            red, counts = K.segment_reduce_with_count(
+                sdata, gid, w, gcap, red_map[fn]
+            )
             return self._window_result(
                 fn, red[gid][inv], counts[gid][inv], c, dtype
             )

@@ -1,0 +1,557 @@
+"""Pipeline fusion: whole-chain compilation of Filter/Project pipelines.
+
+The eager executor pays a jit dispatch, an HLO round-trip, and (for
+projections) a materialized intermediate per plan node. This module is the
+engine's whole-stage-codegen seam (the reference gets the equivalent from
+Spark fusing scan->filter->project into one compiled loop): a plan-rewrite
+pass (`mark_pipelines`) replaces every maximal linear Filter/Project chain
+with a single `plan.Pipeline` node, and the executor compiles that chain
+as ONE jitted function over the child's device columns.
+
+Fusion mechanics (correctness by construction):
+
+  * The jitted function traces the SAME `expr.Evaluator` the eager path
+    runs, so fused and unfused results are identical by construction —
+    bit-exact for integer/decimal/date/string/bool data. Float64
+    expressions can differ in the FINAL ULP only: XLA's algebraic
+    simplifier sees the whole fused expression and may reassociate
+    division chains that eager per-op dispatch rounds individually
+    (measured <= 1e-12 relative on the windowed-ratio templates, vs the
+    validator's 1e-5 epsilon contract). Host-side work the evaluator does
+    over column dictionaries (LIKE lookup tables, IN lists, dictionary
+    unification) runs once at trace time and bakes into the executable as
+    constants — steady-state calls skip it entirely.
+  * Outputs that merely pass an input buffer through (filter stages touch
+    no column data; plain-Col projection items) are detected at build time
+    by tracer identity and PRUNED from the jit signature: the output Table
+    references the input buffers directly, and jax drops the then-unused
+    inputs, so a fused filter allocates exactly what the eager
+    deferred-compaction path allocates (one mask, one queued count) in one
+    dispatch instead of one per plan node and expression op.
+  * Masks and compaction stay deferred to the pipeline boundary: the fused
+    function folds every filter predicate into a single live mask and
+    queues the output count asynchronously, exactly like exec._masked.
+  * When the input table has no mask (live=None), the live mask is built
+    INSIDE the jit from a scalar row count (`count` mode) — no mask buffer
+    crosses the boundary at all. When a mask must be passed and the chain
+    consumes it (does not pass it through), `engine.fuse_donate=on`
+    donates its buffer to the executable. Donation is opt-in: probe-style
+    join outputs alias their left input's live mask across operator
+    boundaries, and plan-cached tables outlive the statement, so blanket
+    donation can invalidate a buffer another table still references (see
+    README "Performance").
+
+Shape-bucketed executable reuse: inputs already ride power-of-two capacity
+buckets (columnar.bucket_cap), and jax caches one executable per (traced
+function, input shapes). `ExecutableCache` keys the traced function by
+(pipeline structure fingerprint, input dtype signature) and tracks the
+(key, bucket) pairs already compiled, so steady-state re-runs AND
+structurally identical queries across a stream reuse executables; the
+hit/miss stream is observable as `exec_cache` trace events and enforced by
+ci/tier1-check's microbench guard (`profile --min_exec_cache_hit_rate`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+
+from . import expr as E
+from . import plan as P
+from .columnar import Column, Table
+from .expr import Evaluator
+
+
+# ---------------------------------------------------------------------------
+# plan rewrite: absorb Filter/Project chains into Pipeline nodes
+# ---------------------------------------------------------------------------
+
+# a pipeline child whose live mask may be donated must be a single-consumer
+# intermediate no cache retains AND whose mask it owns: scans alias catalog
+# buffers; Aggregate/Distinct/SetOp/Window results live in the session plan
+# cache across statements; binary Join outputs alias their LEFT input's
+# live mask on the left/mark augment paths (exec._augment_join_output), so
+# donating their mask would invalidate a buffer the left table still
+# references. MultiJoin stays eligible: its inner/cross steps always mint a
+# fresh mask (matched / compacted / residual) owned by the output alone.
+_NO_DONATE_CHILD = (P.Scan, P.MaterializedScan, P.Join, P.Aggregate,
+                    P.Distinct, P.SetOp, P.Window)
+
+
+def _expr_fusible(e) -> bool:
+    """True when an expression can trace inside one jitted function:
+    anything except subqueries (they execute whole plans and fetch scalars
+    to the host) and aggregate/window functions (never scalar-evaluated).
+    Host-side dictionary work (LIKE, IN, string functions) is fine — it
+    runs at trace time over concrete dictionaries. Chains that still fail
+    to trace (e.g. numeric->string casts, which format device values on
+    host) are caught at build time and pinned to the eager path."""
+    for x in E.walk(e):
+        if isinstance(
+            x, (E.SubqueryExpr, E.ScalarSubquery, E.Agg, E.WindowFn)
+        ):
+            return False
+    return True
+
+
+def _stage_fusible(n) -> bool:
+    if isinstance(n, P.Filter):
+        return _expr_fusible(n.predicate)
+    if isinstance(n, P.Project):
+        return bool(n.items) and all(_expr_fusible(e) for e, _ in n.items)
+    return False
+
+
+def _chain_worth_fusing(stages) -> bool:
+    """A pure-rename/subset chain gains nothing from compilation (the eager
+    path reuses the input column objects outright); fuse only when the
+    chain filters or computes something."""
+    for s in stages:
+        if isinstance(s, P.Filter):
+            return True
+        if any(not isinstance(e, E.Col) for e, _ in s.items):
+            return True
+    return False
+
+
+def _count_refs(node) -> dict:
+    """Plan-node reference counts (subquery plans riding in expressions
+    included). A shared wrapper must not be absorbed into a pipeline: the
+    detached copy would defeat the executor's by-identity result reuse."""
+    refs = {}
+    seen = set()
+
+    def visit(v):
+        if isinstance(v, (P.PlanNode, E.Expr)):
+            if isinstance(v, P.PlanNode):
+                refs[id(v)] = refs.get(id(v), 0) + 1
+            if id(v) in seen:
+                return
+            seen.add(id(v))
+            for f in dataclasses.fields(v):
+                visit(getattr(v, f.name))
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                visit(x)
+
+    visit(node)
+    return refs
+
+
+def mark_pipelines(node: P.PlanNode):
+    """Rewrite every maximal linear Filter/Project chain (anywhere in the
+    tree, subquery plans included) into one `plan.Pipeline` node.
+
+    Returns (root, count): the root itself may head a chain, so callers
+    must adopt the returned root; `count` is the number of pipelines
+    created (plan-introspection aid for tests/tools)."""
+    refs = _count_refs(node)
+    made = 0
+    seen = set()
+
+    def absorb(n):
+        """The Pipeline replacing chain head `n`, or `n` unchanged."""
+        nonlocal made
+        topdown = []
+        cur = n
+        while isinstance(cur, (P.Filter, P.Project)) and _stage_fusible(cur):
+            # shared nodes keep their identity (the executor caches results
+            # by id): a chain stops at the first node with a second parent
+            if refs.get(id(cur), 1) > 1:
+                break
+            topdown.append(cur)
+            cur = cur.child
+        if not topdown or not _chain_worth_fusing(topdown):
+            return n
+        stages = []
+        for s in reversed(topdown):  # execution (innermost-first) order
+            if isinstance(s, P.Filter):
+                stages.append(P.Filter(predicate=s.predicate, child=None))
+            else:
+                stages.append(P.Project(items=list(s.items), child=None))
+        made += 1
+        return P.Pipeline(
+            stages=stages,
+            child=cur,
+            donate_ok=(
+                refs.get(id(cur), 1) <= 1
+                and not isinstance(cur, _NO_DONATE_CHILD)
+            ),
+        )
+
+    def visit(v):
+        if isinstance(v, (P.PlanNode, E.Expr)):
+            if id(v) in seen:
+                return
+            seen.add(id(v))
+            if isinstance(v, P.Sort):
+                # single-consumer annotation for the Limit-over-Sort top-k
+                # gather (exec._exec_limit): a shared Sort must execute in
+                # full once, not top-k for one parent and again in full
+                # for the other
+                v._topk_safe = refs.get(id(v), 1) <= 1
+            if isinstance(v, P.Pipeline):
+                # stages are detached (child=None) fragments: never
+                # re-absorb them; only the real child subtree recurses
+                visit(v.child)
+                return
+            for f in dataclasses.fields(v):
+                cv = getattr(v, f.name)
+                if isinstance(cv, P.PlanNode):
+                    nv = absorb(cv)
+                    if nv is not cv:
+                        # Expr dataclasses are frozen; the plan field of a
+                        # ScalarSubquery is excluded from hash/compare, so
+                        # in-place rewrite is safe
+                        object.__setattr__(v, f.name, nv)
+                        cv = nv
+                elif isinstance(cv, list):
+                    for i, x in enumerate(cv):
+                        if isinstance(x, P.PlanNode):
+                            nx = absorb(x)
+                            if nx is not x:
+                                cv[i] = nx
+                visit(cv)
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                visit(x)
+
+    root = absorb(node)
+    visit(root)
+    return root, made
+
+
+# ---------------------------------------------------------------------------
+# fused evaluation
+# ---------------------------------------------------------------------------
+
+
+class _StatsMarker:
+    """Build-time stand-in for an input column's ColStats: an output column
+    whose stats object survived the chain untouched maps back to the input
+    column index, so every CALL resolves stats from its own input table
+    (bounds captured from a trace-time sample would go stale under
+    executable reuse across datasets)."""
+
+    __slots__ = ("idx",)
+
+    def __init__(self, idx):
+        self.idx = idx
+
+
+class _InCol:
+    """Input-column metadata a FusedPipeline retains (device buffers must
+    not outlive the call — see FusedPipeline.__init__)."""
+
+    __slots__ = ("dtype", "has_valid", "dictionary", "has_stats")
+
+    def __init__(self, dtype, has_valid, dictionary, has_stats):
+        self.dtype = dtype
+        self.has_valid = has_valid
+        self.dictionary = dictionary
+        self.has_stats = has_stats
+
+
+class FusedPipeline:
+    """One compiled Filter/Project chain for one input signature.
+
+    Built once per (stage fingerprint, input signature); jax adds one
+    executable per input capacity bucket underneath the single traced
+    callable. Construction traces the chain abstractly (jax.eval_shape) to
+    capture output structure and the passthrough map; a chain that cannot
+    trace raises, and the ExecutableCache pins its signature to the eager
+    path."""
+
+    def __init__(self, stages, sample: Table):
+        self.stages = stages
+        self.in_names = list(sample.columns)
+        # metadata ONLY — never retain the sample's Column objects: an
+        # entry lives for the session and a retained fact-scale .data
+        # buffer would pin GBs of device memory past any OOM-recovery wipe
+        self.in_meta = [
+            _InCol(
+                c.dtype,
+                c.valid is not None,
+                c.dictionary,
+                c.stats is not None,
+            )
+            for c in sample.columns.values()
+        ]
+        # the dictionaries ARE retained deliberately: the cache key uses
+        # id(dictionary), which stays truthful only while the object is
+        # alive (a recycled address must not alias a new dict), and the
+        # trace bakes their lookup tables in. Host-side, dimension-sized.
+        self.has_filter = any(isinstance(s, P.Filter) for s in stages)
+        # live handling: "count" (live=None input: the mask is built inside
+        # the jit from a scalar row count — no mask buffer at the boundary),
+        # "mask" (explicit mask input), "none" (pure projection over an
+        # unmasked table: liveness never enters the jit)
+        if self.has_filter:
+            self.live_mode = "count" if sample.live is None else "mask"
+        else:
+            self.live_mode = "none" if sample.live is None else "mask_pass"
+        self.out_meta = None
+        self.passthrough = None
+        specs = []
+        if self.live_mode == "count":
+            specs.append(jax.ShapeDtypeStruct((), jnp.int32))
+        elif self.live_mode in ("mask", "mask_pass"):
+            specs.append(jax.ShapeDtypeStruct((sample.cap,), jnp.bool_))
+        for c in sample.columns.values():
+            specs.append(jax.ShapeDtypeStruct(c.data.shape, c.data.dtype))
+        for c in sample.columns.values():
+            if c.valid is not None:
+                specs.append(jax.ShapeDtypeStruct((sample.cap,), jnp.bool_))
+        jax.eval_shape(self._run_full, *specs)
+        # outputs that pass an input buffer through are reassembled from
+        # the caller's own columns; pruning them from the jit lets jax drop
+        # the then-unused inputs entirely (no copies through the
+        # executable)
+        self._kept = [
+            i for i, src in enumerate(self.passthrough) if src is None
+        ]
+        self._jit = jax.jit(self._run_kept)
+        self._jit_donate = None
+
+    # -- traced body ------------------------------------------------------
+    def _flat_inputs(self, flat):
+        i = 0
+        live = None
+        if self.live_mode == "count":
+            n = flat[0]
+            i = 1
+        elif self.live_mode in ("mask", "mask_pass"):
+            live = flat[0]
+            i = 1
+        datas = flat[i:i + len(self.in_meta)]
+        i += len(self.in_meta)
+        cap = int(datas[0].shape[0]) if datas else (
+            int(live.shape[0]) if live is not None else 0
+        )
+        if self.live_mode == "count":
+            live = jnp.arange(cap, dtype=jnp.int32) < n
+        cols = {}
+        vi = i
+        for ci, (name, c, d) in enumerate(
+            zip(self.in_names, self.in_meta, datas)
+        ):
+            valid = None
+            if c.has_valid:
+                valid = flat[vi]
+                vi += 1
+            cols[name] = Column(
+                d, c.dtype, valid, c.dictionary,
+                _StatsMarker(ci) if c.has_stats else None,
+            )
+        nrows = jnp.sum(live, dtype=jnp.int32) if live is not None else 0
+        return Table(cols, nrows, live=live)
+
+    def _run_full(self, *flat):
+        t = self._flat_inputs(flat)
+        for s in self.stages:
+            ev = Evaluator(t)
+            if isinstance(s, P.Filter):
+                pr = ev.eval(s.predicate)
+                mask = pr.data.astype(bool)
+                if pr.valid is not None:
+                    mask = mask & pr.valid
+                mask = mask & t.row_mask()
+                t = Table(
+                    dict(t.columns), jnp.sum(mask, dtype=jnp.int32),
+                    live=mask,
+                )
+            else:
+                cols = {name: ev.eval(e) for e, name in s.items}
+                t = Table(cols, t.nrows_lazy, live=t.live)
+        # flatten outputs + capture structure (side effect: runs at trace
+        # time only, with identical values on every trace)
+        flat_out = []
+        if self.has_filter:
+            flat_out.append(t.nrows_lazy)  # queued count (0-d device)
+            flat_out.append(t.live)
+        self.out_data_base = len(flat_out)
+        for c in t.columns.values():
+            flat_out.append(c.data)
+        valid_slots = []
+        for c in t.columns.values():
+            if c.valid is not None:
+                valid_slots.append(len(flat_out))
+                flat_out.append(c.valid)
+            else:
+                valid_slots.append(None)
+        self.out_valid_slots = valid_slots
+        self.out_meta = [
+            (name, c.dtype, c.dictionary, c.stats)
+            for name, c in t.columns.items()
+        ]
+        self.passthrough = [
+            next((j for j, a in enumerate(flat) if o is a), None)
+            for o in flat_out
+        ]
+        return tuple(flat_out)
+
+    def _run_kept(self, *flat):
+        out = self._run_full(*flat)
+        return tuple(out[i] for i in self._kept)
+
+    # -- call -------------------------------------------------------------
+    def _flat_args(self, table: Table):
+        flat = []
+        if self.live_mode == "count":
+            # asarray, not int(): the count may be a still-queued 0-d
+            # device scalar and must not force a sync here
+            flat.append(jnp.asarray(table.nrows_lazy, dtype=jnp.int32))
+        elif self.live_mode in ("mask", "mask_pass"):
+            flat.append(table.row_mask())
+        for c in table.columns.values():
+            flat.append(c.data)
+        for c in table.columns.values():
+            if c.valid is not None:
+                flat.append(c.valid)
+        return flat
+
+    def _donatable(self):
+        """Flat arg indices safe to donate: the live-mask input, when the
+        chain consumes it rather than passing it through."""
+        if self.live_mode != "mask":
+            return ()
+        if any(src == 0 for src in self.passthrough):
+            return ()
+        return (0,)
+
+    def call(self, table: Table, donate: bool) -> Table:
+        flat = self._flat_args(table)
+        if donate and self._donatable():
+            if self._jit_donate is None:
+                self._jit_donate = jax.jit(
+                    self._run_kept, donate_argnums=self._donatable()
+                )
+            out = self._jit_donate(*flat)
+        else:
+            out = self._jit(*flat)
+        # reassemble: computed slots from the executable, passthrough
+        # slots straight from the caller's own buffers
+        full = [None] * len(self.passthrough)
+        for slot, v in zip(self._kept, out):
+            full[slot] = v
+        for slot, src in enumerate(self.passthrough):
+            if src is not None:
+                full[slot] = flat[src]
+        if self.has_filter:
+            nrows, live = full[0], full[1]
+        else:
+            nrows, live = table.nrows_lazy, table.live
+        in_cols = list(table.columns.values())
+        cols = {}
+        for k, (name, dtype, dic, st) in enumerate(self.out_meta):
+            data = full[self.out_data_base + k]
+            vslot = self.out_valid_slots[k]
+            valid = None if vslot is None else full[vslot]
+            stats = (
+                in_cols[st.idx].subset_stats()
+                if isinstance(st, _StatsMarker)
+                else None  # never trust stats minted at trace time
+            )
+            cols[name] = Column(data, dtype, valid, dic, stats)
+        return Table(
+            cols, nrows, live=live, unique_key=self._out_unique_key(table)
+        )
+
+    def _out_unique_key(self, table: Table):
+        """Replay name flow host-side: filters preserve the input's unique
+        key; projections keep it only when every key column survives as a
+        plain rename (mirrors exec._project_table)."""
+        uk = table.unique_key
+        names = set(table.columns)
+        for s in self.stages:
+            if uk is None:
+                return None
+            if isinstance(s, P.Filter):
+                continue
+            renames = {}
+            for e, name in s.items:
+                if isinstance(e, E.Col):
+                    key = f"{e.table}.{e.name}" if e.table else e.name
+                    if key not in names and e.name in names:
+                        key = e.name
+                    renames.setdefault(key, name)
+            uk = (
+                frozenset(renames[k] for k in uk)
+                if all(k in renames for k in uk)
+                else None
+            )
+            names = {n for _, n in s.items}
+        return uk
+
+
+def input_signature(table: Table):
+    """Hashable identity of an input table's device layout: liveness mode,
+    column names, dtypes, validity presence, dictionary identity (codes are
+    only meaningful relative to their dictionary, and trace-time lookup
+    tables bake it in). Capacity is deliberately absent — jax keys
+    executables per shape bucket underneath one traced callable, which is
+    exactly the shape-bucketed reuse: a query re-run (same bucket) or a
+    structurally identical query at another bucket share the trace."""
+    sig = [table.live is not None]
+    for name, c in table.columns.items():
+        sig.append(
+            (
+                name,
+                repr(c.dtype),
+                c.valid is not None,
+                id(c.dictionary) if c.dictionary is not None else None,
+            )
+        )
+    return tuple(sig)
+
+
+class ExecutableCache:
+    """Session-level cache of FusedPipeline builds keyed by (pipeline
+    structure fingerprint, input signature), with per-(key, bucket)
+    hit/miss accounting — the bucket level is where XLA actually compiles.
+    Entries pin their dictionaries (see input_signature); a failed build is
+    pinned as None so the executor stops re-attempting the fuse. LRU by
+    entry count: entries hold host-side trace machinery, not device
+    buffers."""
+
+    def __init__(self, max_entries: int = 512):
+        self.max_entries = max_entries
+        self.map = OrderedDict()  # (fp, sig) -> FusedPipeline | None
+        self.buckets = set()  # (fp, sig, cap) already compiled
+        self.hits = 0
+        self.misses = 0
+
+    def lookup(self, fp, sig, cap, build):
+        """(FusedPipeline | None, hit: bool)."""
+        key = (fp, sig)
+        if key in self.map:
+            entry = self.map[key]
+            self.map.move_to_end(key)
+        else:
+            try:
+                entry = build()
+            except Exception:
+                entry = None  # unfusible chain: pin to the eager path
+            self.map[key] = entry
+            while len(self.map) > self.max_entries:
+                old_key, _ = self.map.popitem(last=False)
+                self.buckets = {
+                    b for b in self.buckets if b[:2] != old_key
+                }
+        if entry is None:
+            return None, False
+        bkey = (fp, sig, cap)
+        hit = bkey in self.buckets
+        if hit:
+            self.hits += 1
+        else:
+            self.misses += 1
+            self.buckets.add(bkey)
+        return entry, hit
+
+    def clear(self):
+        self.map.clear()
+        self.buckets.clear()

@@ -146,6 +146,30 @@ class MaterializedScan(PlanNode):
     table: object = None  # columnar.Table
 
 
+@dataclass
+class Pipeline(PlanNode):
+    """A maximal linear Filter/Project chain fused into one compiled unit.
+
+    `stages` holds detached Filter/Project nodes (child=None) in EXECUTION
+    order (innermost first); `child` is the chain's input. The executor
+    compiles the whole chain as ONE jitted function over the child's device
+    columns (engine/fuse.py) — no per-node dispatch, no materialized
+    intermediates, masks deferred to the pipeline boundary — and falls back
+    to eager per-stage evaluation when the chain doesn't trace (host-side
+    string casts, subqueries). Structural passes that peel Project/Filter
+    wrappers (blocked union-aggregation shape detection) see through this
+    node via `_peel_wrappers`."""
+
+    stages: list = field(default_factory=list)  # Filter/Project, child=None
+    child: PlanNode = None
+    # set by fuse.mark_pipelines: the child's result is single-consumer and
+    # uncached, so the fused call may donate its live-mask input buffer
+    donate_ok: bool = False
+
+    def children(self):
+        return [self.child]
+
+
 import itertools as _itertools
 
 _fp_serials = _itertools.count()
@@ -206,10 +230,19 @@ def fingerprint(node: PlanNode) -> str:
 
 
 def _peel_wrappers(n):
-    """(Project/Filter wrapper list top-down, first non-wrapper node)."""
+    """(Project/Filter wrapper list top-down, first non-wrapper node).
+
+    Pipeline nodes expand into their stages: fusion must not hide a
+    union-aggregation shape from the blocked-execution path (the detached
+    stage nodes carry no children, which _apply_wrappers never reads)."""
     wrappers = []
-    while isinstance(n, (Project, Filter)):
-        wrappers.append(n)
+    while isinstance(n, (Project, Filter, Pipeline)):
+        if isinstance(n, Pipeline):
+            # stages are in execution (innermost-first) order; the wrapper
+            # list is top-down (outermost first)
+            wrappers.extend(reversed(n.stages))
+        else:
+            wrappers.append(n)
         n = n.child
     return wrappers, n
 
@@ -313,6 +346,10 @@ def node_desc(node: PlanNode) -> str:
         "Limit": lambda: f"Limit {node.n}",
         "Distinct": lambda: "Distinct",
         "SetOp": lambda: f"SetOp {node.op}",
+        "Pipeline": lambda: "Pipeline "
+        + "".join(
+            "F" if isinstance(s, Filter) else "P" for s in node.stages
+        ),
     }.get(name, lambda: name)()
 
 

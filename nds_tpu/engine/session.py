@@ -485,14 +485,31 @@ class Session:
         self.plan_cache = _PlanResultCache(
             int(self.conf.get("engine.plan_cache_bytes", 1 << 30))
         )
+        # fused-pipeline executable reuse (engine/fuse.py): survives catalog
+        # changes on purpose — entries are keyed by stage structure + dtype
+        # signature + dictionary identity, so a stale entry can never be
+        # wrongly hit, and the per-query temp-view churn of a power stream
+        # must not evict the stream-wide executables
+        from .fuse import ExecutableCache
+
+        self.exec_cache = ExecutableCache(
+            int(self.conf.get("engine.exec_cache_entries", 512))
+        )
         # stats of the most recent blocked union-aggregation any executor
         # of this session ran (bench.py's OOM-bail heuristic reads it)
         self.last_blocked_union = None
+        # MultiJoin greedy-order memo: fingerprint -> recorded join steps
+        # (exec._multijoin_greedy). Replaying skips the per-step blocking
+        # row-count syncs of the cost scan on every re-execution.
+        self.join_order_cache = {}
 
     def _catalog_changed(self):
         """Any registration/drop/invalidation: cached plan results may now
         be stale — drop them all."""
         self.plan_cache.clear()
+        # join orders are only a perf heuristic, but sizes may have shifted
+        # enough to make a recorded order pathological — re-derive
+        self.join_order_cache.clear()
 
     # blocked union-aggregation windows get this fraction of the catalog's
     # device budget (the window buffers coexist with cached base tables and
@@ -594,6 +611,11 @@ class Session:
         import gc
 
         self.plan_cache.clear()
+        # fused-pipeline executables bake dictionary lookup tables in as
+        # device constants; a full wipe must release those too (rebuilds
+        # are cheap next to an OOM'd retry failing again)
+        self.exec_cache.clear()
+        self.join_order_cache.clear()
         for e in self.catalog.entries.values():
             e.device_cols = {}
         gc.collect()
@@ -629,18 +651,27 @@ class Session:
             out = self.run_stmt(stmt)
         return out
 
+    def _finish_plan(self, plan):
+        """Post-bind rewrite sequence: prune scans, annotate blocked
+        union-aggregates, then fuse Filter/Project chains into pipelines
+        (fusion last — the blocked-union annotation sees the raw wrappers,
+        and its executor-side shape check peels Pipeline nodes)."""
+        plan = prune_columns(plan, self.catalog)
+        P.mark_blocked_union_aggs(plan)
+        if self.conf.get("engine.fuse", "on") != "off":
+            from .fuse import mark_pipelines
+
+            plan, _ = mark_pipelines(plan)
+        return plan
+
     def run_stmt(self, stmt) -> Optional[Result]:
         if isinstance(stmt, A.SelectStmt):
             binder = Binder(self.catalog)
-            plan = binder.bind(stmt)
-            plan = prune_columns(plan, self.catalog)
-            P.mark_blocked_union_aggs(plan)
+            plan = self._finish_plan(binder.bind(stmt))
             return Result(self, plan)
         if isinstance(stmt, A.CreateViewStmt):
             binder = Binder(self.catalog)
-            plan = binder.bind(stmt.query)
-            plan = prune_columns(plan, self.catalog)
-            P.mark_blocked_union_aggs(plan)
+            plan = self._finish_plan(binder.bind(stmt.query))
             arrow = Result(self, plan).collect()
             self.register_arrow(stmt.name, arrow)
             return None

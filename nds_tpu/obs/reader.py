@@ -245,6 +245,10 @@ def profile_events(events) -> dict:
         "watchdog_fires": 0,
         "faults_injected": 0,
         "blocked_union_windows": 0,
+        "exec_cache_hits": 0,
+        "exec_cache_misses": 0,
+        "pipelines_fused": 0,
+        "pipelines_eager": 0,
     }
     for ev in events:
         k = ev.get("kind")
@@ -279,7 +283,26 @@ def profile_events(events) -> dict:
             tallies["faults_injected"] += 1
         elif k == "blocked_union":
             tallies["blocked_union_windows"] += int(ev.get("windows") or 0)
+        elif k == "exec_cache":
+            tallies[
+                "exec_cache_hits" if ev.get("hit") else "exec_cache_misses"
+            ] += 1
+        elif k == "pipeline_span":
+            tallies[
+                "pipelines_fused" if ev.get("fused") else "pipelines_eager"
+            ] += 1
     return {"queries": queries, "op_totals": op_totals, "tallies": tallies}
+
+
+def exec_cache_hit_rate(prof: dict):
+    """Executable-cache hit rate of a profiled run, or None when the run
+    recorded no exec_cache probes (fusion off / untraced). The CI
+    microbench guard (`profile --min_exec_cache_hit_rate`) reads this."""
+    t = prof["tallies"]
+    probes = t["exec_cache_hits"] + t["exec_cache_misses"]
+    if probes == 0:
+        return None
+    return t["exec_cache_hits"] / probes
 
 
 def compare_profiles(old: dict, new: dict, ratio: float = 1.25,

@@ -54,6 +54,11 @@ EVENT_SCHEMA = {
     "plan_cache": ("node", "hit"),
     # blocked union-aggregation completed (PR 1 window stats)
     "blocked_union": ("windows", "window_rows", "total_rows"),
+    # one fused-pipeline execution (fused=False: eager per-stage fallback)
+    "pipeline_span": ("stages", "fused", "dur_ms"),
+    # executable-cache probe for a pipeline (hit=True: an executable for
+    # this (structure, dtypes, bucket) already existed this session)
+    "exec_cache": ("pipeline", "bucket", "hit"),
     # a fault-injection rule fired (faults.FaultRegistry)
     "fault_injected": ("site", "fault_kind"),
     # one degradation-ladder rung taken (BenchReport)

@@ -441,6 +441,20 @@ def _extreme(dtype, is_max):
     return jnp.asarray(jnp.inf if is_max else -jnp.inf, dtype)
 
 
+@partial(jax.jit, static_argnames=("num_segments", "op"))
+def segment_reduce_with_count(vals, gid, weight, num_segments, op):
+    """(reduction, live count) per segment in ONE dispatch.
+
+    Every non-count aggregate needs both — the count drives SQL
+    NULL-on-empty output validity — and issuing them as two jitted calls
+    paid a second dispatch and let XLA re-derive the masked operand
+    instead of sharing it."""
+    return (
+        segment_reduce(vals, gid, weight, num_segments, op),
+        segment_reduce(vals, gid, weight, num_segments, "count"),
+    )
+
+
 def batched_min_max(datas, valids, live):
     """Masked (min, max) of several int64 columns in one dispatch batch, so
     the caller pays ONE device->host transfer regardless of column count.

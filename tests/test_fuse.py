@@ -1,0 +1,334 @@
+"""Fused operator pipelines + shape-bucketed executable reuse (engine/fuse.py).
+
+Contract under test: a plan's Filter/Project chains collapse into Pipeline
+nodes whose fused (single-jit) execution is BIT-IDENTICAL to the eager
+per-stage path — across nulls, strings, decimals, empty inputs and bucket
+boundaries — while structurally identical executions reuse compiled
+executables (observable through exec_cache trace events), donation +
+OOM-recovery wipes stay safe, and the chains the fuser must not touch
+(blocked union-aggregation wrappers, shared CTE subtrees, untraceable
+host-side casts) keep their exact prior semantics.
+
+Satellite regressions ride along: Limit-over-Sort top-k gather, the
+MultiJoin join-order replay memo, and the SF10 bench isolation helpers.
+"""
+
+import json
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from nds_tpu.engine import fuse as F
+from nds_tpu.engine import plan as P
+from nds_tpu.engine.session import Session
+
+rng = np.random.default_rng(7)
+
+
+def _table(n, seed=0):
+    r = np.random.default_rng(seed)
+    ks = r.integers(0, 15, n)
+    vs = r.integers(-80, 80, n)
+    from decimal import Decimal
+
+    return pa.table(
+        {
+            "k": pa.array(
+                [None if i % 11 == 0 else int(v) for i, v in enumerate(ks)],
+                pa.int32(),
+            ),
+            "v": pa.array(
+                [None if i % 7 == 3 else int(v) for i, v in enumerate(vs)],
+                pa.int64(),
+            ),
+            "cat": pa.array(
+                [
+                    None if i % 13 == 5 else ["Books", "Music", "Shoes", "Home"][int(x) % 4]
+                    for i, x in enumerate(ks)
+                ],
+                pa.string(),
+            ),
+            "amt": pa.array(
+                [Decimal(int(v) * 3) / 100 for v in vs], pa.decimal128(7, 2)
+            ),
+            "d": pa.array(
+                [10957 + int(x) * 37 for x in ks], pa.int32()
+            ),
+        }
+    )
+
+
+def _sessions(n=2000, conf=None, conf_off=None):
+    on = Session(conf=dict(conf or {}))
+    off = Session(conf=dict(conf_off or {}, **{"engine.fuse": "off"}))
+    t = _table(n)
+    u = _table(n, seed=1)
+    for s in (on, off):
+        s.register_arrow("t", t)
+        s.register_arrow("u", u)
+    return on, off
+
+
+EQUALITY_QUERIES = [
+    # plain filter chain (mask-only pipeline, count mode)
+    "select k, v from t where v > 10 and k is not null order by k, v",
+    # filter + computed projection (string LIKE over dictionary)
+    "select k, v * 2 vv, cat from t where cat like 'B%' and v between -50 and 50 "
+    "order by k, vv",
+    # IN list + CASE + decimal arithmetic
+    "select k, case when v > 0 then amt else amt * -1 end aa from t "
+    "where cat in ('Books', 'Shoes') order by k, aa",
+    # null-sensitive predicates (three-valued logic through the fused mask)
+    "select k, v from t where v <> 3 or k = 5 order by k, v",
+    # chain feeding an aggregate (partial-agg input arrives fused)
+    "select k, sum(v) sv, count(*) c, avg(amt) aa from t where v > -60 "
+    "group by k order by k",
+    # post-join linear wrappers (pipeline over a join output)
+    "select x.k, x.s from (select t.k \"k\", t.v + u.v s from t, u "
+    "where t.k = u.k and t.v > u.v) x where x.s > 20 order by x.k, x.s",
+    # date function + projection-only pipeline
+    "select k, year(cast(d as date)) y from t where v >= 0 order by k, y",
+    # empty result through the fused mask
+    "select k, v from t where v > 1000 order by k",
+]
+
+
+@pytest.mark.parametrize("qi", range(len(EQUALITY_QUERIES)))
+def test_fused_path_equality(qi):
+    q = EQUALITY_QUERIES[qi]
+    on, off = _sessions()
+    a = on.sql(q).collect()
+    b = off.sql(q).collect()
+    assert a.equals(b), q
+
+
+def test_float_division_within_validator_epsilon():
+    """The one permitted fused/unfused divergence: float64 expression
+    chains may differ in the FINAL ULP (XLA's algebraic simplifier
+    reassociates division chains it can see whole). Pin the bound at
+    1e-12 relative — four orders of magnitude inside the validator's 1e-5
+    epsilon contract (nds_tpu/validate.py:compare)."""
+    import math
+
+    on, off = _sessions()
+    q = ("select k, sum(v) * 100 / (1 + sum(amt)) r from t "
+         "where v > -70 group by k order by k")
+    a = on.sql(q).collect().to_pylist()
+    b = off.sql(q).collect().to_pylist()
+    assert len(a) == len(b) and a
+    for x, y in zip(a, b):
+        assert x["k"] == y["k"]
+        if x["r"] is None or y["r"] is None:
+            assert x["r"] == y["r"]
+        else:
+            assert math.isclose(x["r"], y["r"], rel_tol=1e-12)
+
+
+def test_fused_over_empty_table():
+    on, off = _sessions()
+    empty = _table(0)
+    for s in (on, off):
+        s.register_arrow("e", empty)
+    q = "select k, v + 1 vv from e where v > 0 order by k"
+    assert on.sql(q).collect().equals(off.sql(q).collect())
+
+
+@pytest.mark.parametrize("n", [1023, 1024, 1025])
+def test_bucket_boundary_rows(n):
+    on, off = _sessions(n=n)
+    q = ("select k, v - 1 w from t where v > 0 and k is not null "
+         "order by k, w")
+    assert on.sql(q).collect().equals(off.sql(q).collect())
+
+
+def test_mark_pipelines_plan_shape():
+    s, _ = _sessions()
+    r = s.sql("select k, v * 2 vv from t where v > 0 and cat like 'B%'")
+    # the chain collapsed into one Pipeline over the scan
+    pipes = []
+
+    def walk(n):
+        if isinstance(n, P.Pipeline):
+            pipes.append(n)
+        for c in n.children():
+            if c is not None:
+                walk(c)
+
+    walk(r.plan)
+    assert len(pipes) == 1
+    p = pipes[0]
+    assert isinstance(p.child, P.Scan)
+    # execution order: filter first, projection last
+    assert isinstance(p.stages[0], P.Filter)
+    assert isinstance(p.stages[-1], P.Project)
+    assert all(st.child is None for st in p.stages)
+    # scans alias catalog buffers: never donation-eligible
+    assert p.donate_ok is False
+    assert "Pipeline" in r.explain()
+
+
+def test_pure_rename_chain_not_fused():
+    s, _ = _sessions()
+    r = s.sql("select k kk, v from t")
+    assert not isinstance(r.plan, P.Pipeline)
+
+
+def test_executable_reuse_and_trace_events(tmp_path):
+    s = Session(conf={"engine.trace_dir": str(tmp_path)})
+    s.register_arrow("t", _table(2000))
+    q = "select k, v + 1 vv from t where v > 0 order by k, vv"
+    s.sql(q).collect()
+    s.conf["engine.plan_cache"] = "off"
+    s.sql(q).collect()
+    evs = [
+        json.loads(line)
+        for line in open(s.tracer.path, encoding="utf-8")
+        if line.strip()
+    ]
+    ec = [e for e in evs if e["kind"] == "exec_cache"]
+    ps = [e for e in evs if e["kind"] == "pipeline_span"]
+    assert ec and ps
+    assert ec[0]["hit"] is False and ec[-1]["hit"] is True
+    assert all(e["fused"] for e in ps)
+    assert all(isinstance(e["bucket"], int) for e in ec)
+
+
+def test_executable_reuse_across_scale_factors():
+    """Same structure + different SF (row count/bucket) => the SAME traced
+    entry serves both; the trace machinery is not rebuilt (VERDICT items
+    4+5: compiled-executable reuse across a stream)."""
+    s = Session()
+    s.register_arrow("t", _table(1500))
+    q = "select k, v + 1 vv from t where v > 0 and k < 10 order by k, vv"
+    expect_small = s.sql(q).collect()
+    assert len(s.exec_cache.map) == 1
+    entry_small = next(iter(s.exec_cache.map.values()))
+    # "SF up": re-register the same schema at 8x the rows (numeric columns
+    # carry no dictionaries, so the input signature is identical)
+    s.register_arrow("t", _table(12000, seed=3))
+    s.sql(q).collect()
+    assert len(s.exec_cache.map) == 1  # same entry, no rebuild
+    assert next(iter(s.exec_cache.map.values())) is entry_small
+    # bucket accounting: two distinct buckets compiled, zero->more hits on
+    # re-run
+    assert s.exec_cache.misses >= 2
+    s.conf["engine.plan_cache"] = "off"
+    hits0 = s.exec_cache.hits
+    s.sql(q).collect()
+    assert s.exec_cache.hits > hits0
+    # and the small result is reproducible after switching back
+    s.register_arrow("t", _table(1500))
+    assert s.sql(q).collect().equals(expect_small)
+
+
+def test_unfusible_chain_pins_to_eager():
+    """A numeric->string cast formats device values on host: the chain
+    cannot trace, the build is attempted once, and results match the
+    unfused path exactly."""
+    on, off = _sessions()
+    q = "select cast(v as varchar(10)) sv, k from t where v > 0 order by k, sv"
+    assert on.sql(q).collect().equals(off.sql(q).collect())
+    pinned = [v for v in on.exec_cache.map.values() if v is None]
+    assert pinned  # the signature is pinned, not re-attempted
+    # re-run still correct (eager fallback path)
+    on.conf["engine.plan_cache"] = "off"
+    assert on.sql(q).collect().equals(off.sql(q).collect())
+
+
+def test_scalar_subquery_stays_unfused_and_correct():
+    on, off = _sessions()
+    q = ("select k, v from t where v > (select avg(v) from u) "
+         "order by k, v")
+    assert on.sql(q).collect().equals(off.sql(q).collect())
+
+
+def test_blocked_union_agg_still_blocked_with_fusion():
+    """The fused wrappers must stay visible to the blocked union-agg shape
+    check (plan._peel_wrappers expands Pipeline nodes), and windowed
+    results must equal the unfused oracle."""
+    conf = {"engine.union_agg_window_rows": 512}
+    on = Session(conf=dict(conf))
+    off = Session(conf=dict(conf, **{"engine.fuse": "off"}))
+    for s in (on, off):
+        s.register_arrow("t", _table(3000))
+        s.register_arrow("u", _table(3000, seed=1))
+    q = """
+    select k, sum(v) sv, count(*) c, avg(v) av
+    from (select k, v from t where v > -70
+          union all
+          select k, v from u) x
+    where v < 70
+    group by k order by k
+    """
+    ra = on.sql(q)
+    a = ra.collect()
+    assert a.equals(off.sql(q).collect())
+    # the blocked path actually engaged under fusion
+    assert ra.executor.last_blocked_union is not None
+    assert ra.executor.last_blocked_union["windows"] > 1
+
+
+def test_donation_safety_and_oom_wipe():
+    """fuse_donate=on over a join-fed pipeline (donate-eligible child):
+    results stable across reruns, and an OOM-recovery wipe (new catalog
+    buffers, new signatures) neither crashes nor changes results."""
+    conf = {"engine.fuse_donate": "on"}
+    on = Session(conf=dict(conf))
+    off = Session(conf={"engine.fuse": "off"})
+    for s in (on, off):
+        s.register_arrow("t", _table(2000))
+        s.register_arrow("u", _table(2000, seed=1))
+    q = ("select x.k, x.s + 1 s1 from (select t.k \"k\", t.v + u.v s "
+         "from t, u where t.k = u.k and t.v > u.v) x where x.s > 10 "
+         "order by x.k, s1")
+    expect = off.sql(q).collect()
+    assert on.sql(q).collect().equals(expect)
+    on.conf["engine.plan_cache"] = "off"
+    assert on.sql(q).collect().equals(expect)
+    on.recover_memory("test: simulated OOM wipe")
+    assert on.sql(q).collect().equals(expect)
+
+
+def test_limit_over_sort_topk():
+    on, off = _sessions()
+    for q in (
+        "select k, v from t order by v desc, k limit 7",
+        "select k, v from t where v > 0 order by k, v limit 1",
+        # limit beyond the row count
+        "select k, v from t where v > 78 order by v, k limit 500",
+        "select cat, amt from t order by cat, amt limit 13",
+    ):
+        assert on.sql(q).collect().equals(off.sql(q).collect()), q
+
+
+def test_join_order_replay_memo():
+    on, _ = _sessions()
+    q = ("select t.k, sum(t.v) s from t, u where t.k = u.k and u.v > 0 "
+         "group by t.k order by t.k")
+    a = on.sql(q).collect()
+    assert len(on.join_order_cache) >= 1
+    recorded = [v for v in on.join_order_cache.values() if "steps" in v]
+    assert recorded
+    on.conf["engine.plan_cache"] = "off"
+    assert on.sql(q).collect().equals(a)  # replayed order, same result
+    # catalog change invalidates the memo
+    on.register_arrow("w", _table(100))
+    assert on.join_order_cache == {}
+
+
+def test_sf10_isolation_helpers():
+    import bench
+
+    assert bench._last_json_line("junk\n{\"a\": 1}\nnot json") == {"a": 1}
+    assert bench._last_json_line("") is None
+    assert bench._OOM_EXIT_RC == 17
+
+
+def test_input_signature_dictionary_identity():
+    s, _ = _sessions()
+    t = s.catalog.load("t")
+    sig1 = F.input_signature(t)
+    sig2 = F.input_signature(s.catalog.load("t"))
+    assert sig1 == sig2  # cached catalog columns: same dictionary objects

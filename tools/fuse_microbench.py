@@ -1,0 +1,110 @@
+"""CI microbench guard: fused-pipeline executable reuse across a stream.
+
+Runs a small synthetic query stream TWICE in one session — first pass
+untraced (it compiles the executables), second pass traced — then gates on
+the profiler's executable-cache hit rate over the traced pass:
+
+    python tools/fuse_microbench.py        # exits nonzero below 80%
+
+A steady-state re-run of a stream must reuse the compiled pipelines (the
+whole point of shape-bucketed executable reuse); a refactor that silently
+changes pipeline fingerprints, input signatures, or the cache keying drops
+the rate to ~0 and fails this gate. Wired into ci/tier1-check.
+"""
+
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import pyarrow as pa  # noqa: E402
+
+MIN_HIT_RATE = float(os.environ.get("NDS_FUSE_MICROBENCH_MIN_RATE", "0.8"))
+
+# a miniature "stream": the chain shapes the fuser must keep compiled —
+# numeric filters, string predicates over dictionaries, computed
+# projections, chains feeding aggregates, post-join wrappers, sort+limit
+STREAM = [
+    "select k, v from t where v > 10 and k is not null order by k, v",
+    "select k, v * 2 vv, cat from t where cat like 'B%' order by k, vv",
+    "select k, sum(v) sv, avg(v) av from t where v > -50 group by k "
+    "order by k",
+    "select x.k, x.s from (select t.k \"k\", t.v + u.v s from t, u "
+    "where t.k = u.k and t.v > u.v) x where x.s > 5 order by x.k, x.s "
+    "limit 20",
+    "select k, case when v > 0 then v else -v end a from t "
+    "where cat in ('Books', 'Shoes') order by k, a limit 50",
+]
+
+
+def _table(n, seed):
+    r = np.random.default_rng(seed)
+    ks = r.integers(0, 12, n)
+    vs = r.integers(-90, 90, n)
+    return pa.table(
+        {
+            "k": pa.array(
+                [None if i % 9 == 0 else int(x) for i, x in enumerate(ks)],
+                pa.int32(),
+            ),
+            "v": pa.array(vs, pa.int64()),
+            "cat": pa.array(
+                [["Books", "Music", "Shoes"][int(x) % 3] for x in ks],
+                pa.string(),
+            ),
+        }
+    )
+
+
+def main():
+    from nds_tpu.engine.session import Session
+    from nds_tpu.obs.trace import tracer_from_conf
+
+    with tempfile.TemporaryDirectory(prefix="nds_fuse_mb_") as trace_dir:
+        sess = Session()
+        sess.register_arrow("t", _table(3000, 1))
+        sess.register_arrow("u", _table(3000, 2))
+        # pass 1 (untraced): compile the stream's pipeline executables
+        for q in STREAM:
+            sess.sql(q).collect()
+        # pass 2 (traced, plan-result cache off so every pipeline really
+        # executes): must ride the executable cache
+        sess.conf["engine.plan_cache"] = "off"
+        sess.tracer = tracer_from_conf({"engine.trace_dir": trace_dir})
+        for q in STREAM:
+            sess.sql(q).collect()
+        sess.tracer.close()
+
+        from nds_tpu.cli import profile as profile_cli
+
+        try:
+            profile_cli.main(
+                [
+                    trace_dir,
+                    "--check",
+                    "--min_exec_cache_hit_rate",
+                    str(MIN_HIT_RATE),
+                ]
+            )
+        except SystemExit as exc:
+            code = int(exc.code or 0)
+            if code:
+                print(
+                    f"fuse_microbench: FAILED (profiler gate exit {code})",
+                    file=sys.stderr,
+                )
+            sys.exit(code)
+    print("fuse_microbench: OK")
+
+
+if __name__ == "__main__":
+    main()
