@@ -1,0 +1,19 @@
+"""Static analysis: plan-IR verification + engine lint.
+
+Two complementary gates over the engine's correctness surface:
+
+* `verifier` — a PlanVerifier that re-checks structural invariants of the
+  logical plan after binding and after each rewrite pass (schema
+  resolvability with stable dtypes, Pipeline chain shape, blocked-union
+  annotation soundness, join-key scoping, LEFT->INNER promotion evidence),
+  the engine's counterpart of Catalyst's re-run analyzer. Gated by conf
+  `engine.verify_plans` / env NDS_VERIFY_PLANS (off | final | all).
+* `lint` — an AST lint over nds_tpu/ codifying the repo's historical bug
+  classes as rules (cross-stream module globals, epoch durations, torn
+  report writes, host syncs in traced regions, hot-path imports, trace
+  event schema drift).
+
+Both run in CI (ci/tier1-check): `tools/plan_verify_corpus.py` statically
+checks ALL 99 TPC-DS query templates through the verifier, and the lint
+must be clean over the package.
+"""

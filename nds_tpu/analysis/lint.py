@@ -1,0 +1,444 @@
+"""Engine lint: AST rules codifying the repo's known bug classes.
+
+Every rule below encodes a bug this codebase actually shipped (and fixed):
+
+  mutable-module-global   PR 3's TRACE_NODES: a module-global dict in the
+                          executor corrupted spans across concurrent
+                          throughput streams. Per-stream state must live on
+                          Session/Executor instances. Scope: engine/, ops/.
+  perf-counter            PR 3 again: durations computed from time.time()
+                          jump with wall-clock adjustments (NTP steps
+                          mid-benchmark corrupt Tpower). Durations must use
+                          time.perf_counter(); epoch stamps are fine.
+  atomic-write            PR 2: a crash mid-`open(path, "w")` leaves a torn
+                          report/state/summary a later reader chokes on.
+                          Harness artifacts must go through
+                          io.fs.fs_open_atomic (tmp + rename).
+                          Scope: top-level harness modules (nds_tpu/*.py).
+  host-sync-in-fuse       fuse.py traced regions run under jax.jit: a host
+                          sync (np.asarray, .block_until_ready(), int() on
+                          a device value) either breaks the trace or forces
+                          a device round-trip per call. Scope: the traced
+                          FusedPipeline bodies in engine/fuse.py.
+  local-import            PR 3: a function-local `import` in the op-span
+                          hot path paid a sys.modules lookup per executed
+                          plan node. Hot-path modules import at module
+                          level; genuinely-cold lazy imports carry a
+                          pragma. Scope: engine/exec.py, engine/expr.py,
+                          engine/fuse.py, ops/kernels.py.
+  trace-event-schema      every `tracer.emit("<kind>", ...)` call's kind
+                          must exist in obs/trace.py:EVENT_SCHEMA and pass
+                          the kind's required fields (or forward **fields),
+                          so schema drift breaks lint instead of the
+                          tolerant trace reader. Scope: everywhere.
+
+Pragma: append `# nds-lint: disable=<rule>[,<rule>...]` (with a
+justification!) on the offending line or the line directly above to
+acknowledge a known-sound exception. `disable=all` silences every rule for
+that line.
+
+Run: `./nds-tpu-submit lint` (or `python -m nds_tpu.cli.lint [path]`);
+exits non-zero on any finding. Wired into ci/tier1-check.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from dataclasses import dataclass
+
+#: rule registry: name -> (scope predicate over package-relative path,
+#: checker). Populated at module bottom.
+RULES = {}
+
+_PRAGMA_RE = re.compile(r"#\s*nds-lint:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+_MUTABLE_CTORS = ("dict", "list", "set", "defaultdict", "OrderedDict",
+                  "Counter", "deque")
+
+#: the FusedPipeline methods that execute under jax tracing (fuse.py)
+_TRACED_FNS = ("_run_full", "_run_kept", "_flat_inputs")
+
+#: hot-path modules where function-local imports are banned
+_HOT_MODULES = (
+    "engine/exec.py", "engine/expr.py", "engine/fuse.py", "ops/kernels.py",
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def _rule(name, scope):
+    def deco(fn):
+        RULES[name] = (scope, fn)
+        return fn
+    return deco
+
+
+def _scope_all(relpath):
+    return True
+
+
+def _scope_engine_ops(relpath):
+    return relpath.startswith(("engine/", "ops/"))
+
+
+def _scope_harness(relpath):
+    # top-level harness modules: report/state/summary artifacts are written
+    # here (engine/io/datagen layers have their own seams)
+    return "/" not in relpath
+
+
+def _scope_fuse(relpath):
+    return relpath == "engine/fuse.py"
+
+
+def _scope_hot(relpath):
+    return relpath in _HOT_MODULES
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+
+@_rule("mutable-module-global", _scope_engine_ops)
+def _r_mutable_module_global(tree, relpath):
+    out = []
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            value, line = node.value, node.lineno
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            value, line = node.value, node.lineno
+        else:
+            continue
+        if _is_mutable_ctor(value):
+            out.append((line, (
+                "module-global mutable container; per-stream state must "
+                "live on Session/Executor instances (the TRACE_NODES "
+                "cross-stream corruption class)"
+            )))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Global):
+            out.append((node.lineno, (
+                f"function rebinds module global(s) "
+                f"{', '.join(node.names)}; shared mutable module state is "
+                f"unsafe across concurrent streams"
+            )))
+    return out
+
+
+def _is_mutable_ctor(value):
+    if isinstance(value, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                          ast.ListComp, ast.SetComp)):
+        return True
+    if isinstance(value, ast.Call) and isinstance(value.func, ast.Name):
+        return value.func.id in _MUTABLE_CTORS
+    return False
+
+
+@_rule("perf-counter", _scope_all)
+def _r_perf_counter(tree, relpath):
+    # names `time` resolves to in this file (import time / from time import
+    # time as x)
+    bare_time_names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name == "time":
+                    bare_time_names.add(a.asname or "time")
+
+    def is_epoch_call(n):
+        if not isinstance(n, ast.Call):
+            return False
+        f = n.func
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr == "time"
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "time"
+        ):
+            return True
+        return isinstance(f, ast.Name) and f.id in bare_time_names
+
+    tainted = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+            is_epoch_call(x) for x in ast.walk(node.value)
+        ):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    tainted.add(t.id)
+    out = []
+    seen_lines = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+            for side in (node.left, node.right):
+                hit = is_epoch_call(side) or (
+                    isinstance(side, ast.Name) and side.id in tainted
+                )
+                if hit and node.lineno not in seen_lines:
+                    seen_lines.add(node.lineno)
+                    out.append((node.lineno, (
+                        "duration computed from time.time(); wall-clock "
+                        "steps (NTP) corrupt elapsed figures — use "
+                        "time.perf_counter() for durations (epoch stamps "
+                        "themselves are fine)"
+                    )))
+    return out
+
+
+@_rule("atomic-write", _scope_harness)
+def _r_atomic_write(tree, relpath):
+    out = []
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "open"
+        ):
+            continue
+        mode = None
+        if len(node.args) >= 2:
+            mode = node.args[1]
+        for kw in node.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        if (
+            isinstance(mode, ast.Constant)
+            and isinstance(mode.value, str)
+            and "w" in mode.value
+        ):
+            out.append((node.lineno, (
+                "bare open(..., 'w') on a harness artifact; a crash "
+                "mid-write leaves a torn file — use io.fs.fs_open_atomic "
+                "(tmp + rename) for report/state/summary paths"
+            )))
+    return out
+
+
+@_rule("host-sync-in-fuse", _scope_fuse)
+def _r_host_sync_in_fuse(tree, relpath):
+    out = []
+    seen = set()  # a _TRACED_FNS name nested in another would double-walk
+    for fn in ast.walk(tree):
+        if not (
+            isinstance(fn, ast.FunctionDef) and fn.name in _TRACED_FNS
+        ):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            seen.add(id(node))
+            f = node.func
+            msg = None
+            if isinstance(f, ast.Attribute):
+                if f.attr in ("block_until_ready", "item"):
+                    msg = f".{f.attr}() forces a host sync"
+                elif (
+                    f.attr in ("asarray", "array")
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in ("np", "numpy")
+                ):
+                    msg = f"np.{f.attr}() pulls a device value to host"
+                elif (
+                    f.attr == "device_get"
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "jax"
+                ):
+                    msg = "jax.device_get() forces a host sync"
+            elif isinstance(f, ast.Name) and f.id in ("int", "float", "bool"):
+                # int() on a .shape element is static metadata, not a sync
+                shapes_only = all(
+                    any(
+                        isinstance(x, ast.Attribute) and x.attr == "shape"
+                        for x in ast.walk(a)
+                    )
+                    for a in node.args
+                )
+                if not shapes_only:
+                    msg = (
+                        f"{f.id}() on a traced value forces a host sync "
+                        f"(or breaks the trace)"
+                    )
+            if msg is not None:
+                out.append((node.lineno, (
+                    f"{msg} inside a jitted FusedPipeline region "
+                    f"({fn.name}); host work belongs at build/call "
+                    f"boundaries"
+                )))
+    return out
+
+
+@_rule("local-import", _scope_hot)
+def _r_local_import(tree, relpath):
+    # dedupe by node id: ast.walk yields nested functions from the outer
+    # function's walk too, which would double-report their imports
+    out = []
+    seen = set()
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                if id(node) in seen:
+                    continue
+                seen.add(id(node))
+                out.append((node.lineno, (
+                    "function-local import in a hot-path module pays a "
+                    "sys.modules lookup per call; import at module level "
+                    "(pragma genuinely-cold lazy imports with a reason)"
+                )))
+    return out
+
+
+@_rule("trace-event-schema", _scope_all)
+def _r_trace_event_schema(tree, relpath):
+    from ..obs.trace import EVENT_SCHEMA
+
+    out = []
+    for kind, kwargs, has_star, line in iter_emit_calls(tree):
+        if kind not in EVENT_SCHEMA:
+            out.append((line, (
+                f"trace event kind {kind!r} is not in "
+                f"obs/trace.py:EVENT_SCHEMA; register it (with its "
+                f"required fields) before emitting"
+            )))
+            continue
+        # `query` is auto-bound from faults.scope by Tracer.emit
+        missing = set(EVENT_SCHEMA[kind]) - set(kwargs) - {"query"}
+        if missing and not has_star:
+            out.append((line, (
+                f"trace event {kind!r} missing required field(s) "
+                f"{sorted(missing)} (EVENT_SCHEMA contract)"
+            )))
+    return out
+
+
+def iter_emit_calls(tree):
+    """Yield (kind, kwarg names, has_star_kwargs, lineno) for every
+    `<obj>.emit("<literal>", ...)` call in the AST. Shared with the
+    golden-sync test that keeps emitted kinds and EVENT_SCHEMA equal."""
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "emit"
+        ):
+            continue
+        if not (
+            node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            continue
+        kwargs = [kw.arg for kw in node.keywords if kw.arg is not None]
+        has_star = any(kw.arg is None for kw in node.keywords)
+        yield node.args[0].value, kwargs, has_star, node.lineno
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def _pragmas(src: str) -> dict:
+    """line number -> set of disabled rule names (or {'all'})."""
+    out = {}
+    for i, line in enumerate(src.splitlines(), start=1):
+        m = _PRAGMA_RE.search(line)
+        if m:
+            out[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+    return out
+
+
+def lint_source(src: str, relpath: str) -> list[Finding]:
+    """Lint one file's source under its package-relative path (the path
+    selects which rules apply)."""
+    tree = ast.parse(src)
+    pragmas = _pragmas(src)
+    findings = []
+    for name, (scope, check) in RULES.items():
+        if not scope(relpath):
+            continue
+        for line, message in check(tree, relpath):
+            disabled = pragmas.get(line, set()) | pragmas.get(line - 1, set())
+            if name in disabled or "all" in disabled:
+                continue
+            findings.append(Finding(relpath, line, name, message))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def package_root() -> str:
+    """The nds_tpu package directory this lint module ships inside."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def iter_py_files(root: str):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [
+            d for d in dirnames if d not in ("__pycache__", "native")
+        ]
+        for f in sorted(filenames):
+            if f.endswith(".py"):
+                yield os.path.join(dirpath, f)
+
+
+def run_lint(root: str | None = None) -> list[Finding]:
+    root = root or package_root()
+    # path-scoped rules key off package-relative paths ("engine/exec.py"):
+    # linting from the REPO root would silently skip every scoped rule and
+    # mis-scope the harness rule onto repo-level scripts — rebase onto the
+    # contained nds_tpu package when the caller passed its parent
+    nested = os.path.join(root, "nds_tpu")
+    if os.path.basename(os.path.abspath(root)) != "nds_tpu" and os.path.isdir(
+        nested
+    ):
+        root = nested
+    findings = []
+    for path in iter_py_files(root):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        findings.extend(lint_source(src, rel))
+    return findings
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="nds-tpu engine lint (AST rules over nds_tpu/)"
+    )
+    ap.add_argument(
+        "root", nargs="?", default=None,
+        help="package root to lint (default: the installed nds_tpu dir)",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true", help="print the rule table"
+    )
+    args = ap.parse_args(argv)
+    if args.list_rules:
+        for name in sorted(RULES):
+            print(name)
+        return 0
+    findings = run_lint(args.root)
+    for f in findings:
+        print(f)
+    n = len(findings)
+    print(f"lint: {n} finding(s)" if n else "lint: clean")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,16 @@
+"""CLI: `nds-tpu-submit lint` — run the engine lint over nds_tpu/.
+
+Exits non-zero on any finding; see nds_tpu/analysis/lint.py for the rule
+table and the `# nds-lint: disable=<rule>` pragma syntax. The static half
+of the CI gate next to `profile --check` (runtime event validation) and
+tools/plan_verify_corpus.py (plan-IR verification of all 99 templates).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ..analysis.lint import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -72,6 +72,13 @@ class Binder:
         self._counter = 0
         self._cte_plans = {}  # name -> (plan, columns) registered per bind
         self._subquery_residual = None  # set by _CorrelatedBinder.run
+        # evidence log of LEFT->INNER promotions this bind performed:
+        # {"conjunct": raw AST conjunct, "refs": promoted-side columns}.
+        # The plan verifier (analysis/verifier.py) re-derives the
+        # null-rejecting shape of each recorded conjunct — a promotion
+        # from a null-tolerant predicate silently drops the outer join's
+        # null-extended rows (the PR-1 wrong-LEFT->INNER bug class).
+        self.promotions = []
 
     def fresh(self, prefix="_c"):
         self._counter += 1
@@ -221,6 +228,10 @@ class Binder:
             for idx in sorted(outer_idx):
                 if refs & rel_cols[idx]:
                     outer_idx.discard(idx)
+                    self.promotions.append({
+                        "conjunct": conj,
+                        "refs": sorted(refs & rel_cols[idx]),
+                    })
                     for pi, (pidx, on_ast, plo) in enumerate(pending_left):
                         if pidx == idx:
                             pending_left.pop(pi)

@@ -18,13 +18,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pyarrow as pa
+import pyarrow.compute as pc
 
 from dataclasses import replace as _dc_replace
 from time import perf_counter as _perf
 
+from .. import faults
 from ..dtypes import BOOL, DType, FLOAT64, INT64
 from ..ops import kernels as K
 from . import expr as E
+from . import fuse
 from . import plan as P
 from .columnar import (
     Column,
@@ -36,7 +39,13 @@ from .columnar import (
     table_device_bytes,
     window_slice,
 )
-from .expr import Evaluator, _and_valid, _cast_column
+from .expr import (
+    Evaluator,
+    _and_valid,
+    _cast_column,
+    _common_dtype,
+    _share_dictionary,
+)
 
 
 class ExecError(Exception):
@@ -96,10 +105,7 @@ def _rollup_base_aggs(agg_items):
     derived per part by _derive_rollup_avgs with the exact semantics of
     the direct avg path (float64, decimal descale, NULL on empty).
     Returns (None, []) when any aggregate rules the rewrite out."""
-    if not all(
-        not a.distinct and a.fn in ("sum", "min", "max", "count", "avg")
-        for a, _ in agg_items
-    ):
+    if not P.aggs_decomposable(agg_items):
         return None, []
     avg_items = [(a, n) for a, n in agg_items if a.fn == "avg"]
     if not avg_items:
@@ -237,12 +243,10 @@ class Executor:
             # ladder sees exactly what a mid-execution device failure
             # looks like. Zero-cost when no fault spec is installed.
             self._fault_checked = True
-            from .. import faults as F
-
-            if F.active():
-                scope = F.current_scope()
+            if faults.active():
+                scope = faults.current_scope()
                 if scope is not None:
-                    F.maybe_fire(f"exec:{scope}")
+                    faults.maybe_fire(f"exec:{scope}")
         key = id(node)
         if key in self._cte_cache:
             return self._cte_cache[key]
@@ -373,17 +377,15 @@ class Executor:
             and child.columns
             and child.cap > 0
         ):
-            from . import fuse as F
-
             fp = getattr(node, "_stage_fp", None)
             if fp is None:
                 fp = node._stage_fp = P.fingerprint(
                     P.Pipeline(stages=node.stages, child=None)
                 )
-            sig = F.input_signature(child)
+            sig = fuse.input_signature(child)
             entry, hit = session.exec_cache.lookup(
                 fp, sig, child.cap,
-                lambda: F.FusedPipeline(node.stages, child),
+                lambda: fuse.FusedPipeline(node.stages, child),
             )
             if tracer is not None:
                 tracer.emit(
@@ -627,6 +629,9 @@ class Executor:
         cap = child.cap
         if cap % n_dev or cap // n_dev == 0:
             return None
+        # mesh-only cold path: keeps jax sharding/collective machinery out
+        # of single-chip startup; reached once per distributed sort
+        # nds-lint: disable=local-import
         from ..parallel.dist import get_sample_sort
 
         # transformed lexsort keys (major->minor), via the same fold as
@@ -1215,6 +1220,8 @@ class Executor:
         n_dev = mesh.devices.size
         if left.cap % n_dev or right.cap % n_dev:
             return None
+        # mesh-only cold path (see _try_dist_sort)
+        # nds-lint: disable=local-import
         from ..parallel.dist import get_exchange_hash_join
 
         lnn = K._all_valid(lv, llive)
@@ -1684,10 +1691,6 @@ class Executor:
         spike the blocked path exists to avoid; only dictionary-sized remap
         tables are built here. Returns one [(out_name, fn(Column)->Column)]
         list per branch, positionally aligned with the branch's columns."""
-        import pyarrow.compute as pc
-
-        from .expr import _common_dtype
-
         names = list(tables[0].columns)
         per_table = [list(t.columns.values()) for t in tables]
         aligners = [[] for _ in tables]
@@ -2008,6 +2011,9 @@ class Executor:
             # opt-in MXU path: per-tile one-hot matmul aggregation
             # (ops/pallas_kernels.py). float32 accumulation — enable only
             # when the validator's relative-epsilon tolerance is acceptable.
+            # opt-in backend (engine.pallas_agg=on): the Pallas import
+            # compiles Mosaic machinery the default path never needs
+            # nds-lint: disable=local-import
             from ..ops.pallas_kernels import segment_sums_pallas
 
             pgid = jnp.where(weight, gid, -1).astype(jnp.int32)
@@ -2503,14 +2509,10 @@ class Executor:
             ca, cb = a.columns[an], b.columns[bn]
             # unify dtypes
             if ca.dtype.is_string or cb.dtype.is_string:
-                from .expr import _share_dictionary
-
                 (da, db), uni = _share_dictionary([ca, cb])
                 dtype = ca.dtype
                 dictionary = uni
             else:
-                from .expr import _common_dtype
-
                 dtype = _common_dtype([ca.dtype, cb.dtype])
                 da = _cast_column(ca, dtype, ca.data.shape[0])
                 db = _cast_column(cb, dtype, cb.data.shape[0])

@@ -24,7 +24,7 @@ import pyarrow as pa
 import pyarrow.compute as pc
 
 from ..dtypes import BOOL, DATE, DType, FLOAT64, INT32, INT64, STRING
-from .columnar import Column, Table, sort_dictionary
+from .columnar import Column, Table, sort_dictionary, unify_dictionaries
 
 _EPOCH = datetime.date(1970, 1, 1)
 
@@ -419,15 +419,11 @@ class Evaluator:
         """Map both string operands to comparable integer keys."""
         if a.dtype.is_string and b.dtype.is_string:
             if op in ("=", "<>"):
-                from .columnar import unify_dictionaries
-
                 ca, cb, _ = unify_dictionaries(a, b)
                 return ca, cb
             ra, _ = sort_dictionary(a)
             rb, _ = sort_dictionary(b)
             # ordering across two dictionaries needs a shared ranking
-            from .columnar import unify_dictionaries
-
             ca, cb, ud = unify_dictionaries(a, b)
             uni_col_a = Column(ca, STRING, a.valid, ud)
             uni_col_b = Column(cb, STRING, b.valid, ud)

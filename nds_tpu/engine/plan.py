@@ -293,12 +293,30 @@ def union_agg_shape(node: "Aggregate"):
     return outer, join, inner, branches
 
 
+def aggs_decomposable(agg_items) -> bool:
+    """True when every aggregate of an Aggregate node decomposes over row
+    windows: plain sum/min/max/count compose with themselves, avg via its
+    hidden sum+count split. Distinct aggregates, stddev/var and grouping()
+    do not merge over partials. The SAME predicate gates the executor's
+    blocked-union machinery (exec._rollup_base_aggs) — the planner
+    annotation and the runtime path must agree, and the plan verifier
+    (analysis/verifier.py) checks annotations against exactly this rule."""
+    return all(
+        not a.distinct and a.fn in ("sum", "min", "max", "count", "avg")
+        for a, _ in agg_items
+    )
+
+
 def mark_blocked_union_aggs(node: PlanNode) -> int:
     """Annotate every Aggregate (anywhere in the tree, subquery plans
-    included) whose input is a union_all chain: sets `blocked_union` so the
-    executor may take the windowed partial-aggregation path. Grouping-set
-    aggregates qualify too — their from-scratch levels run windowed and
-    the rollup cascade re-aggregates the (small) results. Returns the
+    included) whose input is a union_all chain AND whose aggregates
+    decompose over row windows: sets `blocked_union` so the executor may
+    take the windowed partial-aggregation path. Grouping-set aggregates
+    qualify too — their from-scratch levels run windowed and the rollup
+    cascade re-aggregates the (small) results. Non-decomposable aggregate
+    sets (count distinct, stddev) are NOT annotated: the windowed path
+    cannot merge their partials, so annotating them would only invite an
+    unsound rewrite — the verifier flags such annotations. Returns the
     number of nodes marked (plan-introspection aid for tests/tools)."""
     import dataclasses
 
@@ -311,7 +329,11 @@ def mark_blocked_union_aggs(node: PlanNode) -> int:
             if id(v) in seen:
                 return
             seen.add(id(v))
-            if isinstance(v, Aggregate) and union_agg_shape(v) is not None:
+            if (
+                isinstance(v, Aggregate)
+                and aggs_decomposable(v.aggs)
+                and union_agg_shape(v) is not None
+            ):
                 v.blocked_union = True
                 marked += 1
             # generic field recursion reaches subquery plans riding inside

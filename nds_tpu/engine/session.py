@@ -39,6 +39,9 @@ def _enable_persistent_compile_cache():
     99-query compile footprint is paid once per machine, not once per process
     (cold query compiles dominate wall clock ~50x over steady-state
     execution). Opt out with NDS_XLA_CACHE_DIR=0."""
+    # process-wide once-latch, not per-stream state: worst case under a
+    # race is a second, idempotent jax.config.update with the same values
+    # nds-lint: disable=mutable-module-global
     global _PERSISTENT_CACHE_SET
     if _PERSISTENT_CACHE_SET:
         return
@@ -651,27 +654,62 @@ class Session:
             out = self.run_stmt(stmt)
         return out
 
-    def _finish_plan(self, plan):
+    def _finish_plan(self, plan, promotions=()):
         """Post-bind rewrite sequence: prune scans, annotate blocked
         union-aggregates, then fuse Filter/Project chains into pipelines
         (fusion last — the blocked-union annotation sees the raw wrappers,
-        and its executor-side shape check peels Pipeline nodes)."""
+        and its executor-side shape check peels Pipeline nodes).
+
+        With `engine.verify_plans` / NDS_VERIFY_PLANS set (off by default,
+        one dict lookup when off), the PlanVerifier re-checks structural
+        invariants: `final` verifies the finished plan once, `all` verifies
+        after binding and after EACH rewrite pass — the Catalyst-style
+        analyzer re-run. Violations raise PlanVerifyError (a classified
+        `planner` failure: deterministic, the report ladder fails fast) and
+        emit a `plan_verify` trace event per checked stage."""
+        level = self.conf.get("engine.verify_plans") or os.environ.get(
+            "NDS_VERIFY_PLANS"
+        )
+        verify = None
+        if level and str(level).lower() != "off":
+            from ..analysis import verifier as _verifier
+
+            level = _verifier.resolve_level(self.conf)
+
+            def verify(p, stage):
+                _verifier.verify_plan(
+                    p, self.catalog, stage=stage, promotions=promotions,
+                    tracer=self.tracer,
+                )
+
+        if verify is not None and level == "all":
+            verify(plan, "bind")
         plan = prune_columns(plan, self.catalog)
+        if verify is not None and level == "all":
+            verify(plan, "prune_columns")
         P.mark_blocked_union_aggs(plan)
+        if verify is not None and level == "all":
+            verify(plan, "mark_blocked_union_aggs")
         if self.conf.get("engine.fuse", "on") != "off":
             from .fuse import mark_pipelines
 
             plan, _ = mark_pipelines(plan)
+            if verify is not None and level == "all":
+                verify(plan, "mark_pipelines")
+        if verify is not None and level == "final":
+            verify(plan, "final")
         return plan
 
     def run_stmt(self, stmt) -> Optional[Result]:
         if isinstance(stmt, A.SelectStmt):
             binder = Binder(self.catalog)
-            plan = self._finish_plan(binder.bind(stmt))
+            plan = self._finish_plan(binder.bind(stmt), binder.promotions)
             return Result(self, plan)
         if isinstance(stmt, A.CreateViewStmt):
             binder = Binder(self.catalog)
-            plan = self._finish_plan(binder.bind(stmt.query))
+            plan = self._finish_plan(
+                binder.bind(stmt.query), binder.promotions
+            )
             arrow = Result(self, plan).collect()
             self.register_arrow(stmt.name, arrow)
             return None

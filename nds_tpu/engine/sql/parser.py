@@ -33,7 +33,9 @@ _TOKEN_RE = re.compile(
     re.VERBOSE,
 )
 
-KEYWORDS = {
+# frozenset: a read-only vocabulary constant, never per-stream state (and
+# the mutable-module-global lint rule holds engine/ to exactly that)
+KEYWORDS = frozenset({
     "select", "from", "where", "group", "by", "having", "order", "limit",
     "as", "and", "or", "not", "in", "between", "like", "is", "null", "case",
     "when", "then", "else", "end", "cast", "distinct", "union", "all",
@@ -44,7 +46,7 @@ KEYWORDS = {
     "first", "last", "insert", "into", "delete", "create", "drop", "table",
     "view", "temp", "temporary", "using", "location", "partitioned", "call",
     "values", "semi", "anti", "any", "some", "exists", "substring", "top",
-}
+})
 
 
 class Token:

@@ -87,7 +87,11 @@ _IO_PAT = (
     "HTTP 503",
     "503 Service",
 )
-_PLANNER_PAT = ("ParseError", "BindError", "ExecError", "SyntaxError")
+# PlanVerifyError: the static plan verifier (analysis/verifier.py) found a
+# structural invariant violation — deterministic, so the ladder fails fast
+_PLANNER_PAT = (
+    "ParseError", "BindError", "ExecError", "SyntaxError", "PlanVerifyError",
+)
 _DATA_PAT = ("malformed", "LakehouseError", "schema mismatch", "Invalid value")
 
 

@@ -542,6 +542,10 @@ def join_candidates(lkeys, lvalids, llive, rkeys, rvalids, rlive):
     # anyway; the realistic trigger is a pathological cross-join-like key).
     total = int(jnp.sum(counts, dtype=jnp.int64))
     _check_pair_count(total)
+    # genuine import cycle: engine.columnar jits through ops.kernels, so a
+    # module-level import here would deadlock package init; cold path
+    # (sparse-join expansion sizing), one sys.modules hit per expand
+    # nds-lint: disable=local-import
     from ..engine.columnar import bucket_cap
 
     out_cap = bucket_cap(max(total, 1))

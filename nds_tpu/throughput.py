@@ -321,7 +321,10 @@ def _run_throughput_processes(
                 cmd += ["--sub_queries", ",".join(sub_queries)]
             # each child logs to its own file: a shared PIPE read
             # sequentially would block a chatty stream on pipe backpressure
-            # mid-benchmark, stretching its time window and corrupting Ttt
+            # mid-benchmark, stretching its time window and corrupting Ttt.
+            # Append-style live log, not a parsed artifact — a torn final
+            # line is expected crash evidence, so no atomic rename here
+            # nds-lint: disable=atomic-write
             logf = open(f"{time_log_base}_{n}.out", "w")
             try:
                 p = subprocess.Popen(

@@ -27,6 +27,7 @@ import pyarrow as pa
 import pyarrow.dataset as pads
 
 from .io.csv import iter_dat_batches
+from .io.fs import fs_open_atomic
 from .report import engine_conf
 from .schema import TABLE_PARTITIONING, get_maintenance_schemas, get_schemas
 
@@ -117,6 +118,10 @@ def transcode_table(
         import json as _json
 
         os.makedirs(dst, exist_ok=True)
+        # bulk data part file, not a report/state artifact: a torn part is
+        # re-created by re-running the table's transcode, and streaming
+        # row-by-row through a temp rename would double the IO
+        # nds-lint: disable=atomic-write
         with open(os.path.join(dst, basename.format(i=0)), "w") as f:
             for b in batches():
                 for row in b.to_pylist():
@@ -329,7 +334,9 @@ def transcode(args) -> dict:
             "transcode.update": bool(args.update),
         },
     )
-    with open(args.report_file, "w") as report:
+    # atomic: the transcode report is a phase artifact downstream tooling
+    # parses — a crash mid-write must not publish a torn file
+    with fs_open_atomic(args.report_file, "w") as report:
         report.write(report_text)
         print(report_text)
         for item in sorted(engine_conf(conf_src).items()):

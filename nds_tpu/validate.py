@@ -28,6 +28,8 @@ from decimal import Decimal
 
 import pyarrow.dataset as pads
 
+from .io.fs import fs_open_atomic
+
 
 def load_output(path: str, fmt: str):
     """Load one query's written output (power --output_prefix layout)."""
@@ -209,5 +211,7 @@ def update_summary(prefix: str, unmatch_queries: list, query_names: list):
                 summary["queryValidationStatus"] = ["NotAttempted"]
         else:
             summary["queryValidationStatus"] = ["Pass"]
-        with open(filename, "w") as f:
+        # atomic rewrite: this is the query's ONLY summary JSON — a crash
+        # mid-dump must leave the previous complete file, not a torn one
+        with fs_open_atomic(filename, "w") as f:
             json.dump(summary, f, indent=2)

@@ -234,9 +234,12 @@ def test_non_decomposable_stays_unblocked():
     group by k order by k
     """
     out_small, agg = _run(q, 512)
-    # annotated (the shape matches) but executed unblocked (count distinct
-    # does not decompose over row windows)
-    assert agg.blocked_union
+    # NOT annotated: the shape matches but count distinct does not
+    # decompose over row windows, and the annotation pass now applies the
+    # same plan.aggs_decomposable rule the executor's blocked path uses
+    # (the verifier flags a blocked_union mark on a non-decomposable
+    # aggregate as a planner violation — analysis/verifier.py)
+    assert not agg.blocked_union
     assert getattr(agg, "blocked_windows", None) is None
     out_big, _ = _run(q, 10**9)
     assert out_small.to_pylist() == out_big.to_pylist()
