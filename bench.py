@@ -361,6 +361,10 @@ def bench_geomean(sess, block=None, scale=None, wall_budget=None):
                     "sqlite_geomean_sec": round(sq, 4),
                     "ratio": round(eng / sq, 3),
                 }
+                # HEADLINE (ROADMAP item 3): the flat ratio rides every
+                # OUT line until it crosses 1.0 — `profile --bench` diffs
+                # it across rounds
+                OUT["sqlite_shared_ratio"] = round(eng / sq, 3)
         write_detail()
         emit()
 

@@ -31,6 +31,13 @@ Every rule below encodes a bug this codebase actually shipped (and fixed):
                           the kind's required fields (or forward **fields),
                           so schema drift breaks lint instead of the
                           tolerant trace reader. Scope: everywhere.
+  undocumented-conf-knob  carry-forward hygiene: every `engine.*` conf key
+                          the code reads must appear in the README knob
+                          tables or a properties/ template — an invisible
+                          knob can't be tuned, and its emitted engineConf
+                          entry can't be interpreted. Scope: everywhere
+                          (skipped when no README is present, e.g. an
+                          installed package without the repo).
 
 Pragma: append `# nds-lint: disable=<rule>[,<rule>...]` (with a
 justification!) on the offending line or the line directly above to
@@ -321,6 +328,78 @@ def _r_trace_event_schema(tree, relpath):
             out.append((line, (
                 f"trace event {kind!r} missing required field(s) "
                 f"{sorted(missing)} (EVENT_SCHEMA contract)"
+            )))
+    return out
+
+
+_CONF_DOC_CACHE = None
+
+
+def documented_conf_keys():
+    """`engine.*` keys named in the repo's README (knob tables, prose) or
+    any properties/ template — the set the code's reads must stay inside.
+    None when the repo docs aren't present (installed package): the rule
+    then skips rather than flagging everything."""
+    global _CONF_DOC_CACHE
+    if _CONF_DOC_CACHE is None:
+        repo = os.path.dirname(package_root())
+        readme = os.path.join(repo, "README.md")
+        if not os.path.isfile(readme):
+            _CONF_DOC_CACHE = (None,)
+            return None
+        keys = set()
+        with open(readme, encoding="utf-8") as f:
+            keys.update(re.findall(r"engine\.[a-z0-9_]+", f.read()))
+        propdir = os.path.join(repo, "properties")
+        if os.path.isdir(propdir):
+            for name in os.listdir(propdir):
+                if not name.endswith(".properties"):
+                    continue
+                with open(os.path.join(propdir, name),
+                          encoding="utf-8") as f:
+                    keys.update(
+                        re.findall(r"engine\.[a-z0-9_]+", f.read())
+                    )
+        _CONF_DOC_CACHE = (keys,)
+    return _CONF_DOC_CACHE[0]
+
+
+def iter_conf_keys(tree):
+    """Yield (key, lineno) for every `engine.*` conf-key literal read or
+    written in the AST: `<obj>.get("engine.x"[, default])`,
+    `<obj>.setdefault("engine.x", ...)`, and `<obj>["engine.x"]`."""
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("get", "setdefault")
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+            and node.args[0].value.startswith("engine.")
+        ):
+            yield node.args[0].value, node.lineno
+        elif (
+            isinstance(node, ast.Subscript)
+            and isinstance(node.slice, ast.Constant)
+            and isinstance(node.slice.value, str)
+            and node.slice.value.startswith("engine.")
+        ):
+            yield node.slice.value, node.lineno
+
+
+@_rule("undocumented-conf-knob", _scope_all)
+def _r_undocumented_conf_knob(tree, relpath):
+    documented = documented_conf_keys()
+    if documented is None:
+        return []
+    out = []
+    for key, line in iter_conf_keys(tree):
+        if key not in documented:
+            out.append((line, (
+                f"conf knob {key!r} is read by code but absent from the "
+                f"README knob tables / properties templates — document it "
+                f"(with its default) or drop the dead knob"
             )))
     return out
 

@@ -490,10 +490,17 @@ class PlanVerifier:
         from ..engine.fuse import _expr_fusible
 
         child = self._schema_of(node.child)
-        if not node.stages:
+        self._check_donate_ok(node)
+        if not node.stages and node.agg is None:
+            # an agg-tail Pipeline may have an empty chain (the Aggregate
+            # sat directly on its input); a plain one must not
             self._viol("pipeline", node, "Pipeline with no stages")
             return child
-        if isinstance(node.child, P.Pipeline):
+        if isinstance(node.child, P.Pipeline) and node.child.agg is None:
+            # an agg-tail Pipeline child is legitimate (a HAVING chain's
+            # pipeline sits over the fused aggregate it filters; the
+            # aggregate terminates the lower chain, so the two can never
+            # merge) — only plain-over-plain means a non-maximal chain
             self._viol(
                 "pipeline", node,
                 "Pipeline child is itself a Pipeline (chain not maximal)",
@@ -543,7 +550,116 @@ class PlanVerifier:
                     cur = None
             else:
                 cur = self._project_over(node, s.items, cur)
+        if node.agg is not None:
+            return self._check_pipeline_agg(node, cur)
         return cur
+
+    def _check_donate_ok(self, node: P.Pipeline):
+        """`donate_ok` is fuse's clearance to hand the child's buffers to a
+        donating executable — provably wrong whenever another plan node (or
+        a cross-statement cache) can still observe them. Mirrors
+        fuse._donate_ok_child; a rewrite that sets the flag outside these
+        bounds corrupts live memory, so the verifier re-derives it."""
+        if not node.donate_ok:
+            return
+        from ..engine.fuse import _NO_DONATE_CHILD
+
+        if self._refs.get(id(node.child), 1) > 1:
+            self._viol(
+                "donate", node,
+                "donate_ok set but the pipeline child has multiple "
+                "consumers; donating its buffers would invalidate the "
+                "other consumer's input",
+            )
+        elif isinstance(node.child, _NO_DONATE_CHILD) or (
+            isinstance(node.child, P.Pipeline)
+            and node.child.agg is not None
+        ):
+            self._viol(
+                "donate", node,
+                f"donate_ok set on a {type(node.child).__name__} child "
+                f"whose result a cache or base table retains beyond this "
+                f"call",
+            )
+
+    def _check_pipeline_agg(self, node: P.Pipeline, cur):
+        """The fused aggregate tail: detached, unshared, plain-shaped,
+        fully decomposable — the exact eligibility fuse._agg_fusible
+        proved at rewrite time, re-derived here so a later pass that
+        mutates the plan cannot leave a stale (now-wrong) fusion."""
+        from ..engine.fuse import _expr_fusible
+
+        agg = node.agg
+        if agg.child is not None:
+            self._viol(
+                "pipeline-agg", node,
+                "aggregate tail still has an attached child (must be a "
+                "detached copy)",
+            )
+            return None
+        if self._refs.get(id(agg), 1) > 1:
+            self._viol(
+                "pipeline-agg", node,
+                "aggregate tail is referenced elsewhere in the plan "
+                "(Pipeline wraps a shared Aggregate)",
+            )
+            return None
+        if agg.grouping_sets is not None or agg.blocked_union:
+            self._viol(
+                "pipeline-agg", node,
+                "aggregate tail must be plain-shaped (no grouping sets — "
+                "the rollup cascade re-aggregates across levels; no "
+                "blocked_union — the windowed executor owns those)",
+            )
+            return None
+        if not P.aggs_decomposable(agg.aggs):
+            self._viol(
+                "pipeline-agg", node,
+                "non-decomposable aggregate set fused into a Pipeline "
+                "tail (distinct/stddev/grouping cannot run as a direct "
+                "partial-aggregate scatter)",
+            )
+            return None
+        for e, name in agg.keys:
+            if not _expr_fusible(e):
+                self._viol(
+                    "pipeline-agg", node,
+                    f"group key {name!r} is not traceable inside one "
+                    f"jitted dispatch",
+                )
+                return None
+        for a, name in agg.aggs:
+            if a.arg is not None and not _expr_fusible(a.arg):
+                self._viol(
+                    "pipeline-agg", node,
+                    f"aggregate argument of {name!r} is not traceable "
+                    f"inside one jitted dispatch",
+                )
+                return None
+        if cur is None:
+            return None
+        out = {}
+        for g, name in agg.keys:
+            dt = self._try_expr(g, cur, node, f"group key {name!r}")
+            if dt is None:
+                return None
+            if name in out:
+                self._viol(
+                    "schema", node, f"duplicate output column {name!r}"
+                )
+                return None
+            out[name] = dt
+        for a, name in agg.aggs:
+            dt = self._agg_dtype(a, cur, agg)
+            if dt is None:
+                return None
+            if name in out:
+                self._viol(
+                    "schema", node, f"duplicate output column {name!r}"
+                )
+                return None
+            out[name] = dt
+        return out
 
     # ------------------------------------------------------------------
     # aggregate / window dtype rules (mirror exec._eval_agg/_eval_window)

@@ -6,6 +6,7 @@ event logs).
         [--top N] [--per_query] [--json] [--check]
     python -m nds_tpu.cli.profile --compare OLD NEW
         [--ratio 1.25] [--min_ms 50] [--fail_on_regression]
+        [--bench OLD_BENCH NEW_BENCH]
 
 Single-run mode aggregates one or more event logs (files or trace dirs —
 a throughput run's per-stream files profile together naturally) into
@@ -102,9 +103,102 @@ def _render_profile(prof, top: int, per_query: bool):
               f"{t['pipelines_eager']} eager; executable cache "
               f"{t['exec_cache_hits']} hit / {t['exec_cache_misses']} miss "
               f"(rate {rate_s})")
+    kernels = sorted(
+        prof.get("kernel_totals", {}).items(),
+        key=lambda kv: -kv[1]["dur_ms"],
+    )[:top]
+    if kernels:
+        print(f"\n== top {len(kernels)} kernels by dispatch time "
+              f"(kernel_span; NDS_TRACE_KERNELS runs)")
+        print(f"   {'kernel':<28}{'count':>6}{'total_ms':>12}"
+              f"{'avg_ms':>10}{'rows':>14}")
+        for name, k in kernels:
+            avg = k["dur_ms"] / k["count"] if k["count"] else 0.0
+            print(f"   {name:<28}{k['count']:>6}{k['dur_ms']:>12,.1f}"
+                  f"{avg:>10,.3f}{k['n_rows']:>14,}")
+
+
+def _load_sqlite_shared(path):
+    """The `sqlite_shared` block out of a bench artifact: a saved compact
+    OUT line / bench JSON-lines output, or a driver capture whose `tail`
+    holds the last emitted line. Returns the dict or None."""
+    import re
+
+    with open(path) as fh:
+        text = fh.read()
+    best = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj.get("sqlite_shared"), dict):
+            best = obj["sqlite_shared"]
+        elif isinstance(obj.get("tail"), str):
+            # driver wrapper: scan the captured tail for the last block
+            m = None
+            for m in re.finditer(r'"sqlite_shared":\s*(\{[^{}]*\})',
+                                 obj["tail"]):
+                pass
+            if m is not None:
+                try:
+                    best = json.loads(m.group(1))
+                except ValueError:
+                    pass
+    return best
+
+
+def _compare_sqlite_shared(old_path, new_path):
+    """sqlite_shared headline comparison records (ROADMAP item 3: publish
+    the engine-vs-sqlite shared-subset ratio until it crosses 1.0, flag
+    when it worsens). Regression: the ratio rose more than 2% — geomeans
+    over ~100 queries are stable, so drift beyond that is a real loss."""
+    old = _load_sqlite_shared(old_path)
+    new = _load_sqlite_shared(new_path)
+    out = []
+    if new is None:
+        out.append({
+            "level": "bench", "change": "status_change",
+            "query": "sqlite_shared",
+            "detail": f"no sqlite_shared block in {new_path}",
+        })
+        return out
+    r_new = new.get("ratio")
+    r_old = old.get("ratio") if old else None
+    rec = {
+        "level": "bench", "query": "sqlite_shared",
+        "old_ratio": r_old, "new_ratio": r_new,
+        "queries": new.get("queries"),
+        "change": "headline",
+    }
+    if r_old is not None and r_new is not None and r_new > r_old * 1.02:
+        rec["change"] = "regression"
+    out.append(rec)
+    return out
+
+
+def _print_bench_rec(r):
+    old_s = "-" if r["old_ratio"] is None else f"{r['old_ratio']:.3f}"
+    flag = "  ** REGRESSED" if r["change"] == "regression" else ""
+    above = (
+        "  (still above parity — target < 1.0)"
+        if (r["new_ratio"] or 0) > 1.0
+        else ""
+    )
+    print(f"== sqlite_shared ratio: {old_s} -> {r['new_ratio']:.3f} over "
+          f"{r['queries']} shared queries{flag}{above}")
 
 
 def _render_compare(regs, ratio, min_ms):
+    # the sqlite_shared headline always prints, regressed or not (the
+    # ratio is published every round until it crosses 1.0)
+    headline = [r for r in regs if r["change"] == "headline"]
+    regs = [r for r in regs if r["change"] != "headline"]
+    for r in headline:
+        _print_bench_rec(r)
     if not regs:
         print(f"== no regressions (threshold: {ratio:.2f}x and "
               f">= {min_ms:.0f} ms)")
@@ -114,6 +208,8 @@ def _render_compare(regs, ratio, min_ms):
     for r in regs:
         if r["change"] == "status_change":
             print(f"   {r['query']}: {r['detail']}")
+        elif r.get("level") == "bench":
+            _print_bench_rec(r)
         elif r["level"] == "query":
             print(f"   {r['query']}: wall {r['old_ms']:,.1f} -> "
                   f"{r['new_ms']:,.1f} ms ({r['ratio']:.2f}x)")
@@ -134,6 +230,12 @@ def main(argv=None):
     parser.add_argument(
         "--compare", nargs=2, metavar=("OLD", "NEW"),
         help="A/B mode: two event logs / trace dirs to diff",
+    )
+    parser.add_argument(
+        "--bench", nargs=2, metavar=("OLD", "NEW"),
+        help="bench artifacts (saved compact OUT lines / driver captures) "
+        "to diff the sqlite_shared headline ratio, alongside or instead "
+        "of --compare",
     )
     parser.add_argument("--top", type=int, default=10,
                         help="top-N hottest operators (10)")
@@ -158,17 +260,22 @@ def main(argv=None):
                         help="compare: exit 1 when regressions are flagged")
     args = parser.parse_args(argv)
 
-    if args.compare:
-        old_prof = R.profile_events(_load([args.compare[0]], args.check))
-        new_prof = R.profile_events(_load([args.compare[1]], args.check))
-        regs = R.compare_profiles(
-            old_prof, new_prof, ratio=args.ratio, min_ms=args.min_ms
-        )
+    if args.compare or args.bench:
+        regs = []
+        if args.compare:
+            old_prof = R.profile_events(_load([args.compare[0]], args.check))
+            new_prof = R.profile_events(_load([args.compare[1]], args.check))
+            regs = R.compare_profiles(
+                old_prof, new_prof, ratio=args.ratio, min_ms=args.min_ms
+            )
+        if args.bench:
+            regs.extend(_compare_sqlite_shared(*args.bench))
         if args.as_json:
             print(json.dumps({"regressions": regs}, indent=2))
         else:
             _render_compare(regs, args.ratio, args.min_ms)
-        if regs and args.fail_on_regression:
+        bad = [r for r in regs if r["change"] != "headline"]
+        if bad and args.fail_on_regression:
             sys.exit(1)
         return
     if not args.paths:

@@ -93,6 +93,15 @@ class Column:
     valid: Optional[jnp.ndarray] = None  # bool; None == all valid
     dictionary: Optional[pa.Array] = None  # for string dtypes: distinct values
     stats: Optional[ColStats] = None  # base-table stats (see ColStats)
+    # buffer OWNERSHIP: True iff this column's data/valid buffers were
+    # freshly minted for this one table by its producer (join pair gathers,
+    # compaction takes) and alias nothing another live table references.
+    # Consumed by fused-pipeline full-column donation (engine/fuse.py):
+    # only owned, single-consumer, non-passthrough buffers may be donated
+    # to an executable. Conservatively False everywhere else — a False on
+    # a fresh buffer only costs a missed donation, a True on an aliased
+    # buffer would invalidate memory another table still reads.
+    owned: bool = False
 
     @property
     def is_string(self) -> bool:
@@ -100,6 +109,17 @@ class Column:
 
     def with_valid(self, valid: Optional[jnp.ndarray]) -> "Column":
         return replace(self, valid=valid)
+
+    def disowned(self) -> "Column":
+        """This column shared by reference into ANOTHER table (join/filter/
+        project passthrough): two tables now reference the buffer, and the
+        sharing site cannot prove the source table is transient — e.g. a
+        CTE or plan-cache entry retains it across reads — so neither side
+        may treat the buffer as exclusively owned. Every executor path that
+        copies Column objects across a plan-node boundary must route
+        through this (a stale True would let fused-pipeline donation free
+        memory the retained table still reads)."""
+        return replace(self, owned=False) if self.owned else self
 
     def subset_stats(self) -> Optional[ColStats]:
         """Stats valid for any row-subset/permutation of this column."""

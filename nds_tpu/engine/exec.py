@@ -251,11 +251,12 @@ class Executor:
         if key in self._cte_cache:
             return self._cte_cache[key]
         tracer = self.tracer
-        cache = (
-            self._session_cache()
-            if isinstance(node, self._CACHEABLE)
-            else None
+        # agg-tail Pipelines are the fused form of a (cacheable) Aggregate:
+        # they keep the cross-statement CTE reuse the raw node had
+        cacheable = isinstance(node, self._CACHEABLE) or (
+            isinstance(node, P.Pipeline) and node.agg is not None
         )
+        cache = self._session_cache() if cacheable else None
         if cache is not None:
             hit = cache.get(self._fp(node))
             if tracer is not None:
@@ -328,10 +329,14 @@ class Executor:
 
     def _project_table(self, child: Table, items) -> Table:
         ev = self._evaluator(child)
+        child_cols = {id(c) for c in child.columns.values()}
         cols = {}
         renames = {}  # child column name -> output name (plain Col items)
         for e, name in items:
-            cols[name] = ev.eval(e)
+            c = ev.eval(e)
+            # plain renames share the child's Column object: ownership must
+            # not cross the node boundary (the child may be cache-retained)
+            cols[name] = c.disowned() if id(c) in child_cols else c
             if isinstance(e, E.Col):
                 # mirror Evaluator._eval_col resolution order
                 key = f"{e.table}.{e.name}" if e.table else e.name
@@ -371,21 +376,37 @@ class Executor:
         t0 = _perf() if tracer is not None else 0.0
         out = None
         fused = False
+        has_agg = node.agg is not None
         if (
             session is not None
             and session.conf.get("engine.fuse", "on") != "off"
             and child.columns
             and child.cap > 0
+            # backstop only — the plan rewrite already skips agg absorption
+            # under a Pallas mode (Session._finish_plan), so this fires
+            # solely for plans cached before conf flipped pallas_agg on:
+            # the fused scatter would bypass the per-aggregate Pallas seam
+            and not (
+                has_agg
+                and session.conf.get("engine.pallas_agg", "off") != "off"
+            )
         ):
             fp = getattr(node, "_stage_fp", None)
             if fp is None:
                 fp = node._stage_fp = P.fingerprint(
-                    P.Pipeline(stages=node.stages, child=None)
+                    P.Pipeline(stages=node.stages, child=None, agg=node.agg)
                 )
-            sig = fuse.input_signature(child)
+            sig = fuse.input_signature(child, with_stats=has_agg)
+            if has_agg:
+                def build():
+                    return fuse.FusedAggPipeline(
+                        node.stages, node.agg, child
+                    )
+            else:
+                def build():
+                    return fuse.FusedPipeline(node.stages, child)
             entry, hit = session.exec_cache.lookup(
-                fp, sig, child.cap,
-                lambda: fuse.FusedPipeline(node.stages, child),
+                fp, sig, child.cap, build
             )
             if tracer is not None:
                 tracer.emit(
@@ -404,9 +425,9 @@ class Executor:
                 except Exception as exc:
                     if donate:
                         # the failed call may already have donated (and so
-                        # invalidated) the child's live mask — an eager
-                        # retry over those buffers would read garbage;
-                        # surface the failure to the harness ladder instead
+                        # invalidated) the child's input buffers — an eager
+                        # retry over those would read garbage; surface the
+                        # failure to the harness ladder instead
                         raise
                     # compile/runtime failure on a chain that traced
                     # abstractly: pin the signature to the eager path
@@ -417,11 +438,17 @@ class Executor:
         if out is None:
             # eager per-stage path (_apply_wrappers wants top-down order)
             out = self._apply_wrappers(child, list(reversed(node.stages)))
+            if has_agg:
+                out = self._aggregate_once(
+                    node.agg.keys, node.agg.aggs, None, out,
+                    out.row_mask(), out.nrows_known,
+                )
         if tracer is not None:
             tracer.emit(
                 "pipeline_span",
                 stages=len(node.stages),
                 fused=fused,
+                agg=has_agg,
                 dur_ms=round((_perf() - t0) * 1000.0, 3),
                 rows=out.nrows_known,
             )
@@ -942,7 +969,9 @@ class Executor:
                 ok = self._apply_residual(ok, li, ri, left, right, residual)
             present = K.matched_mask(li, ok, left.cap)
             if kind == "mark":
-                out_cols = dict(left.columns)
+                out_cols = {
+                    n: c.disowned() for n, c in left.columns.items()
+                }
                 out_cols[mark_name] = Column(present, BOOL)
                 return Table(out_cols, left.nrows_lazy, live=left.live)
             mask = (present if kind == "semi" else ~present) & llive
@@ -1062,7 +1091,7 @@ class Executor:
         rnn = K._all_valid([rv[0]], rlive)
         rkey = rk[0].astype(jnp.int64)
         table_cap = bucket_cap(domain)
-        presence, rows = K.dense_build(rkey, rnn, rmin, table_cap)
+        presence, rows = self._dense_build_route(rkey, rnn, rmin, table_cap)
         lnn = K._all_valid([lv[0]], llive)
         matched, ri = K.dense_probe(
             lk[0].astype(jnp.int64), lnn, rmin, presence, rows, table_cap
@@ -1079,7 +1108,9 @@ class Executor:
         count sync, no compaction gathers."""
         if kind in ("semi", "anti", "mark"):
             if kind == "mark":
-                out_cols = dict(left.columns)
+                out_cols = {
+                    n: c.disowned() for n, c in left.columns.items()
+                }
                 out_cols[mark_name] = Column(matched, BOOL)
                 return Table(
                     out_cols, left.nrows_lazy, live=left.live,
@@ -1088,25 +1119,34 @@ class Executor:
             mask = (matched if kind == "semi" else ~matched) & llive
             return self._masked(left, mask)
         if kind == "inner":
-            out_cols = dict(left.columns)
+            # LEFT columns pass through by reference and are DISOWNED: the
+            # left table may be a CTE/plan-cache-retained result (e.g. the
+            # first relation of a MultiJoin), and a passthrough that kept
+            # owned=True would let a downstream donating pipeline free
+            # buffers that cached table still reads. Right-side gathers
+            # are fresh buffers owned by this output alone.
+            out_cols = {n: c.disowned() for n, c in left.columns.items()}
             ri_safe = jnp.where(matched, ri, 0)
             for name, c in right.columns.items():
                 valid = None if c.valid is None else c.valid[ri_safe]
                 out_cols[name] = Column(
                     c.data[ri_safe], c.dtype, valid, c.dictionary,
-                    c.gather_stats(),
+                    c.gather_stats(), owned=True,
                 )
             pair = Table(
                 dict(out_cols), jnp.sum(matched, dtype=jnp.int32),
                 live=matched, unique_key=left.unique_key,
             )
             if residual is not None:
+                # pair is a function-local transient: its freshly minted
+                # right-side gathers stay owned through the masked view
                 return self._masked(
-                    pair, self._predicate_mask(pair, residual)
+                    pair, self._predicate_mask(pair, residual),
+                    transient=True,
                 )
             return pair
         # left join: left-aligned output, unmatched rows null on the right
-        out_cols = dict(left.columns)
+        out_cols = {n: c.disowned() for n, c in left.columns.items()}
         ri_safe = jnp.where(matched, ri, 0)
         for name, c in right.columns.items():
             valid = c.valid[ri_safe] if c.valid is not None else jnp.ones(left.cap, bool)
@@ -1288,7 +1328,8 @@ class Executor:
                 valid = l_out[mi] & ok
                 mi += 1
             cols[name] = Column(
-                l_out[i], c.dtype, valid, c.dictionary, c.gather_stats()
+                l_out[i], c.dtype, valid, c.dictionary, c.gather_stats(),
+                owned=True,
             )
         mi = nr
         for i, (name, c) in enumerate(right.columns.items()):
@@ -1297,7 +1338,8 @@ class Executor:
                 valid = r_out[mi] & ok
                 mi += 1
             cols[name] = Column(
-                r_out[i], c.dtype, valid, c.dictionary, c.gather_stats()
+                r_out[i], c.dtype, valid, c.dictionary, c.gather_stats(),
+                owned=True,
             )
         # compacting by the pair mask keeps exactly the verified pairs; the
         # gathered (shipped_valid & ok) buffers equal shipped_valid on every
@@ -1380,7 +1422,10 @@ class Executor:
         return [as_i64(a)], [as_i64(b)]
 
     def _pair_table(self, left, right, li, ri, nrows, rnull, lnull=None):
-        # join-output gather can repeat rows: bounds survive, uniqueness dies
+        # join-output gather can repeat rows: bounds survive, uniqueness
+        # dies. Every buffer below is a fresh gather output owned by this
+        # table alone — marked owned so a downstream fused pipeline may
+        # donate it (engine/fuse.py:_donate_slots)
         cols = {}
         for name, c in left.columns.items():
             data = c.data[li]
@@ -1389,7 +1434,7 @@ class Executor:
                 v = valid if valid is not None else jnp.ones(li.shape[0], bool)
                 valid = v & ~lnull
             cols[name] = Column(data, c.dtype, valid, c.dictionary,
-                                c.gather_stats())
+                                c.gather_stats(), owned=True)
         for name, c in right.columns.items():
             data = c.data[ri]
             valid = None if c.valid is None else c.valid[ri]
@@ -1397,7 +1442,7 @@ class Executor:
                 v = valid if valid is not None else jnp.ones(ri.shape[0], bool)
                 valid = v & ~rnull
             cols[name] = Column(data, c.dtype, valid, c.dictionary,
-                                c.gather_stats())
+                                c.gather_stats(), owned=True)
         return Table(cols, nrows)
 
     def _cross_join(self, left, right):
@@ -1586,6 +1631,73 @@ class Executor:
                 t = self._project_table(t, w.items)
         return t
 
+    def _apply_wrappers_fused(self, t: Table, wrappers, memo) -> Table:
+        """Apply a top-down wrapper list through ONE fused executable when
+        the chain traces — the blocked union-aggregation per-window path:
+        every window of a branch shares the same shape bucket and input
+        signature, so the first window builds the executable and the other
+        N-1 windows ride the exec cache instead of paying an eager dispatch
+        per wrapper per window. `memo` (per-blocked-context dict) caches
+        the detached stage list + fingerprint per wrapper chain. Falls back
+        to the exact eager per-wrapper path whenever fusion is off, the
+        chain has an unfusible stage, or the build failed."""
+        if not wrappers:
+            return t
+        session = getattr(self.catalog, "session", None)
+        if (
+            session is None
+            or session.conf.get("engine.fuse", "on") == "off"
+            or not t.columns
+            or t.cap == 0
+        ):
+            return self._apply_wrappers(t, wrappers)
+        key = tuple(id(w) for w in wrappers)
+        info = memo.get(key)
+        if info is None:
+            stages = []
+            for w in reversed(wrappers):  # execution order
+                if not fuse._stage_fusible(w):
+                    stages = None
+                    break
+                if isinstance(w, P.Filter):
+                    stages.append(P.Filter(predicate=w.predicate, child=None))
+                else:
+                    stages.append(P.Project(items=list(w.items), child=None))
+            if stages and not fuse._chain_worth_fusing(stages):
+                # pure rename/subset wrappers: the eager path reuses the
+                # window's column objects outright — a compiled dispatch
+                # per window would only add copies (same gate as
+                # mark_pipelines)
+                stages = None
+            fp = (
+                P.fingerprint(P.Pipeline(stages=stages, child=None))
+                if stages
+                else None
+            )
+            info = memo[key] = (fp, stages)
+        fp, stages = info
+        if fp is None:
+            return self._apply_wrappers(t, wrappers)
+        sig = fuse.input_signature(t)
+        entry, hit = session.exec_cache.lookup(
+            fp, sig, t.cap, lambda: fuse.FusedPipeline(stages, t)
+        )
+        if self.tracer is not None:
+            self.tracer.emit(
+                "exec_cache", pipeline=fp[:12], bucket=t.cap, hit=hit,
+                fused=entry is not None,
+            )
+        if entry is None:
+            return self._apply_wrappers(t, wrappers)
+        try:
+            return entry.call(t, False)  # windows alias branch buffers
+        except Exception as exc:
+            session.exec_cache.map[(fp, sig)] = None
+            self.on_task_failure(
+                f"window fuse fallback: {str(exc)[:120]}"
+            )
+            return self._apply_wrappers(t, wrappers)
+
     def _blocked_union_once(self, node: P.Aggregate, ctx, subset):
         """One aggregation level (grouping-set `subset`, or None for the
         plain shape) over the union input, evaluated window by window with
@@ -1611,7 +1723,10 @@ class Executor:
                     w.nrows_lazy,
                     live=w.live,
                 )
-                t = self._apply_wrappers(t, ctx["inner_wrappers"])
+                t = self._apply_wrappers_fused(
+                    t, ctx["inner_wrappers"],
+                    ctx.setdefault("wrapper_memo", {}),
+                )
                 if ctx["join"] is not None:
                     edges, uidx, others = ctx["join"]
                     t = self._multijoin_over_tables(
@@ -1620,7 +1735,10 @@ class Executor:
                         trace=ctx["join_trace"],
                     )
                     ctx["max_table_cap"] = max(ctx["max_table_cap"], t.cap)
-                t = self._apply_wrappers(t, ctx["outer_wrappers"])
+                t = self._apply_wrappers_fused(
+                    t, ctx["outer_wrappers"],
+                    ctx.setdefault("wrapper_memo", {}),
+                )
                 part = self._aggregate_once(
                     node.keys, ctx["base_aggs"], subset, t, t.row_mask(),
                     t.nrows_known,
@@ -2007,25 +2125,10 @@ class Executor:
         if fn == "count":
             counts = K.segment_reduce(sdata, gid, weight, gcap, "count")
             return Column(counts.astype(jnp.int64), INT64)
-        if fn == "sum" and self._use_pallas_agg(c.dtype):
-            # opt-in MXU path: per-tile one-hot matmul aggregation
-            # (ops/pallas_kernels.py). float32 accumulation — enable only
-            # when the validator's relative-epsilon tolerance is acceptable.
-            # opt-in backend (engine.pallas_agg=on): the Pallas import
-            # compiles Mosaic machinery the default path never needs
-            # nds-lint: disable=local-import
-            from ..ops.pallas_kernels import segment_sums_pallas
-
-            pgid = jnp.where(weight, gid, -1).astype(jnp.int32)
-            # mask dead/null lanes: a zero one-hot entry does not neutralize
-            # NaN garbage (0*NaN=NaN would poison the whole group tile)
-            pvals = jnp.where(weight, sdata, 0).astype(jnp.float32)
-            s, n = segment_sums_pallas(
-                pvals, pgid, gcap,
-                interpret=jax.devices()[0].platform != "tpu",
-            )
-            return Column(s.astype(jnp.float64), c.dtype, n > 0)
         if fn in ("sum", "min", "max"):
+            pall = self._pallas_segment_route(fn, c, sdata, gid, weight, gcap)
+            if pall is not None:
+                return pall
             red, counts = K.segment_reduce_with_count(
                 sdata, gid, weight, gcap, fn
             )
@@ -2058,14 +2161,164 @@ class Executor:
             return Column(out, FLOAT64, n > 1)
         raise ExecError(f"aggregate {fn}")
 
-    def _use_pallas_agg(self, dtype) -> bool:
-        """engine.pallas_agg=on routes float SUMs through the Pallas MXU
-        one-hot-matmul groupby. Opt-in because accumulation is float32 (the
-        reference's --floats mode tolerance, not exact-decimal)."""
+    def _pallas_segment_route(self, fn, c, sdata, gid, weight, gcap):
+        """Opt-in Pallas segment-reduce promotion for float64 measures.
+
+        `engine.pallas_agg`: `off` (default) — the jnp/XLA scatter path;
+        `on` — always route sum/min/max through the Pallas tile kernels
+        (ops/pallas_kernels.py: one-hot MXU matmul for sum, VPU tile
+        min/max); `auto` — MEASURED promotion: the first call at each
+        (fn, input cap, group cap) shape times both paths (post-warmup, so
+        compile cost is excluded) and promotes only when Pallas actually
+        wins on this backend, recording both measurements as `kernel_span`
+        events — promotion on data, not faith. All modes are float32
+        accumulation (the reference's --floats tolerance), so float64
+        measures only; exact int64/decimal reductions never route here."""
+        mode = self._pallas_mode()
+        if mode not in ("on", "auto") or c.dtype.kind != "float64":
+            return None
+        # opt-in backend: the Pallas import compiles Mosaic machinery the
+        # default path never needs
+        # nds-lint: disable=local-import
+        from ..ops import pallas_kernels as PK
+
+        interpret = jax.devices()[0].platform != "tpu"
+        pgid = jnp.where(weight, gid, -1).astype(jnp.int32)
+        # mask dead/null lanes: a zero one-hot entry does not neutralize
+        # NaN garbage (0*NaN=NaN would poison the whole group tile)
+        pvals = jnp.where(weight, sdata, 0).astype(jnp.float32)
+        if mode == "auto" and not self._pallas_promoted(
+            fn, sdata, gid, weight, gcap, pvals, pgid, interpret
+        ):
+            return None
+        if fn == "sum":
+            s, n = PK.segment_sums_pallas(
+                pvals, pgid, gcap, interpret=interpret
+            )
+        else:
+            s, n = PK.segment_extreme_pallas(
+                pvals, pgid, gcap, fn == "max", interpret=interpret
+            )
+        return Column(s.astype(jnp.float64), c.dtype, n > 0)
+
+    def _pallas_mode(self) -> str:
         session = getattr(self.catalog, "session", None)
-        if session is None or session.conf.get("engine.pallas_agg") != "on":
-            return False
-        return dtype.kind == "float64"
+        if session is None:
+            return "off"
+        return str(session.conf.get("engine.pallas_agg", "off")).lower()
+
+    def _dense_build_route(self, rkey, rnn, rmin, table_cap):
+        """Join-candidate build-table promotion (`engine.pallas_join`):
+        `off` — the jnp scatter-max pair; `on` — the Pallas one-hot tile
+        kernel (exact integer maxima, no numeric caveat); `auto` — the
+        same measured per-shape A/B as the aggregate route, recorded as
+        `kernel_span` evidence and memoized on `Session.pallas_promotions`
+        under key ("dense_build", rows, table_cap)."""
+        session = getattr(self.catalog, "session", None)
+        mode = (
+            str(session.conf.get("engine.pallas_join", "off")).lower()
+            if session is not None
+            else "off"
+        )
+        if mode not in ("on", "auto"):
+            return K.dense_build(rkey, rnn, rmin, table_cap)
+        # opt-in backend: the Pallas import compiles Mosaic machinery the
+        # default path never needs
+        # nds-lint: disable=local-import
+        from ..ops import pallas_kernels as PK
+
+        interpret = jax.devices()[0].platform != "tpu"
+        if mode == "auto":
+            key = ("dense_build", int(rkey.shape[0]), int(table_cap))
+            rec = session.pallas_promotions.get(key)
+            if rec is None:
+                rec = self._measure_promotion(
+                    key,
+                    lambda: K.dense_build(rkey, rnn, rmin, table_cap),
+                    lambda: PK.dense_build_pallas(
+                        rkey, rnn, rmin, table_cap, interpret=interpret
+                    ),
+                    "dense_build",
+                )
+            if not rec["use"]:
+                return K.dense_build(rkey, rnn, rmin, table_cap)
+        return PK.dense_build_pallas(
+            rkey, rnn, rmin, table_cap, interpret=interpret
+        )
+
+    def _measure_promotion(self, key, run_jnp, run_pallas, kname):
+        """One-time measured A/B for a (kernel, shape) promotion slot:
+        warm both paths (compiles land in the jit caches either way), time
+        one synchronized call each, memoize the winner on the session and
+        emit both measurements as `kernel_span` events."""
+        session = self.catalog.session
+
+        def timed(run):
+            jax.block_until_ready(run())  # warmup: exclude compile
+            t0 = _perf()
+            jax.block_until_ready(run())
+            return (_perf() - t0) * 1000.0
+
+        jnp_ms = timed(run_jnp)
+        try:
+            pallas_ms = timed(run_pallas)
+        except Exception:
+            pallas_ms = float("inf")  # no Pallas lowering: never promote
+        rec = session.pallas_promotions[key] = {
+            "jnp_ms": round(jnp_ms, 3),
+            "pallas_ms": (
+                round(pallas_ms, 3) if pallas_ms != float("inf") else None
+            ),
+            "use": pallas_ms < jnp_ms,
+        }
+        if self.tracer is not None:
+            self.tracer.emit(
+                "kernel_span", kernel=f"{kname}:jnp",
+                dur_ms=rec["jnp_ms"], n=key[1],
+            )
+            if rec["pallas_ms"] is not None:
+                self.tracer.emit(
+                    "kernel_span", kernel=f"{kname}:pallas",
+                    dur_ms=rec["pallas_ms"], n=key[1],
+                )
+        return rec
+
+    def _pallas_promoted(
+        self, fn, sdata, gid, weight, gcap, pvals, pgid, interpret
+    ) -> bool:
+        """One-time measured A/B per (fn, rows-bucket, group-bucket) shape,
+        memoized on the session (`Session.pallas_promotions`): warm both
+        paths (executables land in the jit caches either way), then time
+        one synchronized call each; the Pallas route is used only where it
+        measured faster. Both measurements emit `kernel_span` events so
+        `profile` can show the promotion evidence per shape."""
+        session = self.catalog.session
+        key = (fn, int(sdata.shape[0]), int(gcap))
+        rec = session.pallas_promotions.get(key)
+        if rec is None:
+            # nds-lint: disable=local-import
+            from ..ops import pallas_kernels as PK
+
+            def run_jnp():
+                return K.segment_reduce_with_count(
+                    sdata, gid, weight, gcap, fn
+                )
+
+            if fn == "sum":
+                def run_pallas():
+                    return PK.segment_sums_pallas(
+                        pvals, pgid, gcap, interpret=interpret
+                    )
+            else:
+                def run_pallas():
+                    return PK.segment_extreme_pallas(
+                        pvals, pgid, gcap, fn == "max", interpret=interpret
+                    )
+
+            rec = self._measure_promotion(
+                key, run_jnp, run_pallas, f"segment_{fn}"
+            )
+        return rec["use"]
 
     def _eval_distinct_agg(self, agg, ev, child, subset, key_cols, gcap,
                            ngroups, key_words=None):
@@ -2139,7 +2392,7 @@ class Executor:
         # windows sort and scan several word/rank arrays at the input cap:
         # always pack masked inputs first (memory AND time win)
         child = self.execute(node.child).compacted()
-        out_cols = dict(child.columns)
+        out_cols = {n: c.disowned() for n, c in child.columns.items()}
         for wf, name in node.fns:
             out_cols[name] = self._eval_window(child, wf)
         return Table(out_cols, child.nrows_lazy, live=child.live)
@@ -2446,14 +2699,24 @@ class Executor:
                 )
         return self._scalar_cache[key]
 
-    def _masked(self, table: Table, mask) -> Table:
+    def _masked(self, table: Table, mask, transient: bool = False) -> Table:
         """Deferred compaction: keep rows in place under a live mask, with
         the count queued asynchronously (device->host syncs cost ~90 ms on
         the bench tunnel; a full compaction also pays one gather per
         column). Downstream operators consume row_mask() directly; packing
-        happens lazily at collect()/limit via Table.compacted()."""
+        happens lazily at collect()/limit via Table.compacted().
+
+        Columns are shared by reference, so ownership is stripped unless
+        the caller passes `transient=True` to assert `table` is a
+        function-local temporary no cache or second consumer retains
+        (e.g. a join's just-minted pair table under a residual filter)."""
+        cols = (
+            dict(table.columns)
+            if transient
+            else {n: c.disowned() for n, c in table.columns.items()}
+        )
         return Table(
-            dict(table.columns), jnp.sum(mask, dtype=jnp.int32), live=mask,
+            cols, jnp.sum(mask, dtype=jnp.int32), live=mask,
             unique_key=table.unique_key,
         )
 
@@ -2465,7 +2728,8 @@ class Executor:
 
     def _take(self, table: Table, idx, nrows) -> Table:
         # idx is a permutation or de-duplicated subset of live rows
-        # (sort order / compact indices), so base-table stats stay valid
+        # (sort order / compact indices), so base-table stats stay valid;
+        # gather outputs are fresh owned buffers
         cols = {}
         for name, c in table.columns.items():
             cols[name] = Column(
@@ -2474,6 +2738,7 @@ class Executor:
                 None if c.valid is None else c.valid[idx],
                 c.dictionary,
                 c.subset_stats(),
+                owned=True,
             )
         return Table(cols, nrows)
 

@@ -55,13 +55,16 @@ from __future__ import annotations
 
 import dataclasses
 from collections import OrderedDict
+from dataclasses import replace as _dc_replace
 
 import jax
 import jax.numpy as jnp
 
+from ..dtypes import FLOAT64, INT64
+from ..ops import kernels as K
 from . import expr as E
 from . import plan as P
-from .columnar import Column, Table
+from .columnar import Column, Table, bucket_cap, sort_dictionary
 from .expr import Evaluator
 
 
@@ -105,6 +108,28 @@ def _stage_fusible(n) -> bool:
     return False
 
 
+def _agg_fusible(n: P.Aggregate) -> bool:
+    """True when an Aggregate can become a Pipeline's fused tail: plain
+    shape only (no grouping sets — the rollup cascade re-aggregates across
+    levels; no blocked_union — the windowed path owns those), every
+    aggregate decomposable (sum/min/max/count/avg, no distinct — the same
+    predicate the blocked-union path gates on), and every key/argument
+    expression traceable. Whether the key DOMAIN is small enough for the
+    direct scatter is a data property checked at build time (column stats);
+    ineligible inputs pin to the eager path per input signature."""
+    if n.grouping_sets is not None or n.blocked_union:
+        return False
+    if not P.aggs_decomposable(n.aggs):
+        return False
+    for e, _ in n.keys:
+        if not _expr_fusible(e):
+            return False
+    for a, _ in n.aggs:
+        if a.arg is not None and not _expr_fusible(a.arg):
+            return False
+    return True
+
+
 def _chain_worth_fusing(stages) -> bool:
     """A pure-rename/subset chain gains nothing from compilation (the eager
     path reuses the input column objects outright); fuse only when the
@@ -141,9 +166,31 @@ def _count_refs(node) -> dict:
     return refs
 
 
-def mark_pipelines(node: P.PlanNode):
+def _donate_ok_child(cur, refs) -> bool:
+    """Plan-level donation clearance for a pipeline's child: the child's
+    result must be single-consumer, never retained by a cross-statement
+    cache (Aggregate/Distinct/SetOp/Window AND agg-tail Pipelines live in
+    the session plan cache), and never an aliasing producer
+    (_NO_DONATE_CHILD). WHICH buffers are then actually donatable is a
+    runtime property (Column.owned + passthrough analysis in the fused
+    call); this gate only proves no OTHER plan node can observe them."""
+    if refs.get(id(cur), 1) > 1:
+        return False
+    if isinstance(cur, _NO_DONATE_CHILD):
+        return False
+    if isinstance(cur, P.Pipeline) and cur.agg is not None:
+        return False  # plan-cached, same as a raw Aggregate
+    return True
+
+
+def mark_pipelines(node: P.PlanNode, fuse_aggs: bool = True):
     """Rewrite every maximal linear Filter/Project chain (anywhere in the
-    tree, subquery plans included) into one `plan.Pipeline` node.
+    tree, subquery plans included) into one `plan.Pipeline` node; with
+    `fuse_aggs` (conf `engine.fuse_agg`, on by default), a plain
+    decomposable Aggregate additionally absorbs the chain FEEDING it and
+    becomes the Pipeline's fused aggregate tail — the whole
+    scan→filter→project→partial-aggregate run then compiles as one
+    dispatch (engine/fuse.py:FusedAggPipeline).
 
     Returns (root, count): the root itself may head a chain, so callers
     must adopt the returned root; `count` is the number of pipelines
@@ -152,9 +199,10 @@ def mark_pipelines(node: P.PlanNode):
     made = 0
     seen = set()
 
-    def absorb(n):
-        """The Pipeline replacing chain head `n`, or `n` unchanged."""
-        nonlocal made
+    def chain_under(n):
+        """(detached stages in execution order, chain input) for the
+        maximal fusible single-consumer Filter/Project chain headed at
+        `n` (possibly empty)."""
         topdown = []
         cur = n
         while isinstance(cur, (P.Filter, P.Project)) and _stage_fusible(cur):
@@ -164,22 +212,44 @@ def mark_pipelines(node: P.PlanNode):
                 break
             topdown.append(cur)
             cur = cur.child
-        if not topdown or not _chain_worth_fusing(topdown):
-            return n
         stages = []
         for s in reversed(topdown):  # execution (innermost-first) order
             if isinstance(s, P.Filter):
                 stages.append(P.Filter(predicate=s.predicate, child=None))
             else:
                 stages.append(P.Project(items=list(s.items), child=None))
+        return stages, cur
+
+    def absorb(n):
+        """The Pipeline replacing chain head `n`, or `n` unchanged."""
+        nonlocal made
+        if (
+            fuse_aggs
+            and isinstance(n, P.Aggregate)
+            and refs.get(id(n), 1) <= 1
+            and _agg_fusible(n)
+        ):
+            # the aggregate tail + the chain feeding it fuse into ONE node;
+            # a detached copy keeps the executor's by-identity caches away
+            # from the original (which this rewrite discards)
+            stages, cur = chain_under(n.child)
+            made += 1
+            return P.Pipeline(
+                stages=stages,
+                child=cur,
+                donate_ok=_donate_ok_child(cur, refs),
+                agg=P.Aggregate(
+                    keys=list(n.keys), aggs=list(n.aggs), child=None
+                ),
+            )
+        topdown_stages, cur = chain_under(n)
+        if not topdown_stages or not _chain_worth_fusing(topdown_stages):
+            return n
         made += 1
         return P.Pipeline(
-            stages=stages,
+            stages=topdown_stages,
             child=cur,
-            donate_ok=(
-                refs.get(id(cur), 1) <= 1
-                and not isinstance(cur, _NO_DONATE_CHILD)
-            ),
+            donate_ok=_donate_ok_child(cur, refs),
         )
 
     def visit(v):
@@ -194,8 +264,14 @@ def mark_pipelines(node: P.PlanNode):
                 # for the other
                 v._topk_safe = refs.get(id(v), 1) <= 1
             if isinstance(v, P.Pipeline):
-                # stages are detached (child=None) fragments: never
-                # re-absorb them; only the real child subtree recurses
+                # stages/agg are detached (child=None) fragments: never
+                # re-absorb them; only the real child subtree recurses —
+                # and that child may itself head an absorbable shape (a
+                # HAVING chain's pipeline sits over a fusible Aggregate)
+                nv = absorb(v.child)
+                if nv is not v.child:
+                    v.child = nv
+                    v.donate_ok = _donate_ok_child(nv, refs)
                 visit(v.child)
                 return
             for f in dataclasses.fields(v):
@@ -255,18 +331,12 @@ class _InCol:
         self.has_stats = has_stats
 
 
-class FusedPipeline:
-    """One compiled Filter/Project chain for one input signature.
+class _FusedBase:
+    """Shared input plumbing of the fused callables: flat-argument layout,
+    abstract Table reconstruction inside the trace, stage application, and
+    ownership-based donation-slot analysis."""
 
-    Built once per (stage fingerprint, input signature); jax adds one
-    executable per input capacity bucket underneath the single traced
-    callable. Construction traces the chain abstractly (jax.eval_shape) to
-    capture output structure and the passthrough map; a chain that cannot
-    trace raises, and the ExecutableCache pins its signature to the eager
-    path."""
-
-    def __init__(self, stages, sample: Table):
-        self.stages = stages
+    def _capture_inputs(self, sample: Table):
         self.in_names = list(sample.columns)
         # metadata ONLY — never retain the sample's Column objects: an
         # entry lives for the session and a retained fact-scale .data
@@ -284,17 +354,8 @@ class FusedPipeline:
         # id(dictionary), which stays truthful only while the object is
         # alive (a recycled address must not alias a new dict), and the
         # trace bakes their lookup tables in. Host-side, dimension-sized.
-        self.has_filter = any(isinstance(s, P.Filter) for s in stages)
-        # live handling: "count" (live=None input: the mask is built inside
-        # the jit from a scalar row count — no mask buffer at the boundary),
-        # "mask" (explicit mask input), "none" (pure projection over an
-        # unmasked table: liveness never enters the jit)
-        if self.has_filter:
-            self.live_mode = "count" if sample.live is None else "mask"
-        else:
-            self.live_mode = "none" if sample.live is None else "mask_pass"
-        self.out_meta = None
-        self.passthrough = None
+
+    def _input_specs(self, sample: Table):
         specs = []
         if self.live_mode == "count":
             specs.append(jax.ShapeDtypeStruct((), jnp.int32))
@@ -305,18 +366,8 @@ class FusedPipeline:
         for c in sample.columns.values():
             if c.valid is not None:
                 specs.append(jax.ShapeDtypeStruct((sample.cap,), jnp.bool_))
-        jax.eval_shape(self._run_full, *specs)
-        # outputs that pass an input buffer through are reassembled from
-        # the caller's own columns; pruning them from the jit lets jax drop
-        # the then-unused inputs entirely (no copies through the
-        # executable)
-        self._kept = [
-            i for i, src in enumerate(self.passthrough) if src is None
-        ]
-        self._jit = jax.jit(self._run_kept)
-        self._jit_donate = None
+        return specs
 
-    # -- traced body ------------------------------------------------------
     def _flat_inputs(self, flat):
         i = 0
         live = None
@@ -349,8 +400,10 @@ class FusedPipeline:
         nrows = jnp.sum(live, dtype=jnp.int32) if live is not None else 0
         return Table(cols, nrows, live=live)
 
-    def _run_full(self, *flat):
-        t = self._flat_inputs(flat)
+    def _apply_stages(self, t: Table) -> Table:
+        """The evaluator chain, stage by stage, inside the trace — the SAME
+        Evaluator the eager path runs, so fused results match eager by
+        construction."""
         for s in self.stages:
             ev = Evaluator(t)
             if isinstance(s, P.Filter):
@@ -366,6 +419,167 @@ class FusedPipeline:
             else:
                 cols = {name: ev.eval(e) for e, name in s.items}
                 t = Table(cols, t.nrows_lazy, live=t.live)
+        return t
+
+    def _flat_args(self, table: Table):
+        flat = []
+        if self.live_mode == "count":
+            # asarray, not int(): the count may be a still-queued 0-d
+            # device scalar and must not force a sync here
+            flat.append(jnp.asarray(table.nrows_lazy, dtype=jnp.int32))
+        elif self.live_mode in ("mask", "mask_pass"):
+            flat.append(table.row_mask())
+        for c in table.columns.values():
+            flat.append(c.data)
+        for c in table.columns.values():
+            if c.valid is not None:
+                flat.append(c.valid)
+        return flat
+
+    def _analyze_donation(self, fn, specs, cap):
+        """Build-time donation feasibility: (consumed slots, output aval
+        templates). `consumed` is the flat input slots the compiled body
+        actually reads (jaxpr dead-code elimination — an owned input that
+        only fed a pruned passthrough output, or a stage value a later
+        projection dropped, is DCE'd by XLA). The templates are the
+        computed outputs' (dtype, shape) with the sample capacity
+        normalized to "cap": jax only aliases a donated buffer into an
+        output with the IDENTICAL aval, so donating without a matching
+        output reclaims nothing, emits jax's unusable-donation warning on
+        every compile, and forks a pointless executable variant per
+        owned-pattern. (None, None) means "donate whatever ownership
+        allows" — the analysis rides a jax-internal API, and any drift
+        only costs those warnings, never correctness."""
+        try:
+            # build-time-only cold path (once per compiled executable, never
+            # per call) AND a jax-internal module kept inside the guarding
+            # try so an import-time rename degrades like any other drift
+            # nds-lint: disable=local-import
+            from jax.interpreters import partial_eval as pe
+
+            jaxpr = jax.make_jaxpr(fn)(*specs).jaxpr
+            _, used = pe.dce_jaxpr(jaxpr, [True] * len(jaxpr.outvars))
+            consumed = frozenset(i for i, u in enumerate(used) if u)
+            outs = [
+                (
+                    v.aval.dtype,
+                    tuple(
+                        "cap" if d == cap else d for d in v.aval.shape
+                    ),
+                )
+                for v in jaxpr.outvars
+            ]
+            return consumed, outs
+        except Exception:
+            return None, None
+
+    def _donate_slots(self, table: Table, flat) -> tuple:
+        """Flat arg indices safe AND useful to donate for THIS call: the
+        consumed live-mask input (the plan rewrite's donate_ok gate already
+        proved the child single-consumer and its mask freshly minted), plus
+        every data/validity buffer the producer marked Column.owned —
+        excluding buffers that pass through to the output, buffers the
+        executable never consumes, buffers with no same-aval computed
+        output left to alias into (see _analyze_donation for both), and
+        buffers appearing more than once in the argument list (a `select
+        k, k k2` projection feeds one buffer twice; donating either copy
+        would invalidate the other)."""
+        pt = getattr(self, "passthrough", None) or ()
+        pt_srcs = {s for s in pt if s is not None}
+        consumed = getattr(self, "_consumed", None)
+        templates = getattr(self, "_out_avals", None)
+        avail = None
+        if templates is not None:
+            cap = table.cap
+            avail = {}
+            for dt, shape in templates:
+                key = (
+                    dt, tuple(cap if d == "cap" else d for d in shape)
+                )
+                avail[key] = avail.get(key, 0) + 1
+
+        def ok(slot):
+            if (
+                slot in pt_srcs
+                or (consumed is not None and slot not in consumed)
+                or counts[id(flat[slot])] != 1
+            ):
+                return False
+            if avail is None:
+                return True
+            key = (flat[slot].dtype, tuple(flat[slot].shape))
+            if avail.get(key, 0) <= 0:
+                return False
+            avail[key] -= 1  # one output buffer aliases one donation
+            return True
+
+        counts = {}
+        for x in flat:
+            counts[id(x)] = counts.get(id(x), 0) + 1
+        slots = []
+        i = 0
+        if self.live_mode == "count":
+            i = 1  # 0-d scalar: nothing to donate
+        elif self.live_mode in ("mask", "mask_pass"):
+            if self.live_mode == "mask" and ok(0):
+                slots.append(0)
+            i = 1
+        cols = list(table.columns.values())
+        for ci, c in enumerate(cols):
+            slot = i + ci
+            if c.owned and ok(slot):
+                slots.append(slot)
+        vi = i + len(cols)
+        for c in cols:
+            if c.valid is None:
+                continue
+            if c.owned and ok(vi):
+                slots.append(vi)
+            vi += 1
+        return tuple(slots)
+
+
+class FusedPipeline(_FusedBase):
+    """One compiled Filter/Project chain for one input signature.
+
+    Built once per (stage fingerprint, input signature); jax adds one
+    executable per input capacity bucket underneath the single traced
+    callable. Construction traces the chain abstractly (jax.eval_shape) to
+    capture output structure and the passthrough map; a chain that cannot
+    trace raises, and the ExecutableCache pins its signature to the eager
+    path."""
+
+    def __init__(self, stages, sample: Table):
+        self.stages = stages
+        self._capture_inputs(sample)
+        self.has_filter = any(isinstance(s, P.Filter) for s in stages)
+        # live handling: "count" (live=None input: the mask is built inside
+        # the jit from a scalar row count — no mask buffer at the boundary),
+        # "mask" (explicit mask input), "none" (pure projection over an
+        # unmasked table: liveness never enters the jit)
+        if self.has_filter:
+            self.live_mode = "count" if sample.live is None else "mask"
+        else:
+            self.live_mode = "none" if sample.live is None else "mask_pass"
+        self.out_meta = None
+        self.passthrough = None
+        jax.eval_shape(self._run_full, *self._input_specs(sample))
+        # outputs that pass an input buffer through are reassembled from
+        # the caller's own columns; pruning them from the jit lets jax drop
+        # the then-unused inputs entirely (no copies through the
+        # executable)
+        self._kept = [
+            i for i, src in enumerate(self.passthrough) if src is None
+        ]
+        self._consumed, self._out_avals = self._analyze_donation(
+            self._run_kept, self._input_specs(sample), sample.cap
+        )
+        self._jit = jax.jit(self._run_kept)
+        self._jit_donate = {}  # donate-slot tuple -> jitted callable
+
+    # -- traced body ------------------------------------------------------
+    def _run_full(self, *flat):
+        t = self._apply_stages(self._flat_inputs(flat))
         # flatten outputs + capture structure (side effect: runs at trace
         # time only, with identical values on every trace)
         flat_out = []
@@ -398,38 +612,16 @@ class FusedPipeline:
         return tuple(out[i] for i in self._kept)
 
     # -- call -------------------------------------------------------------
-    def _flat_args(self, table: Table):
-        flat = []
-        if self.live_mode == "count":
-            # asarray, not int(): the count may be a still-queued 0-d
-            # device scalar and must not force a sync here
-            flat.append(jnp.asarray(table.nrows_lazy, dtype=jnp.int32))
-        elif self.live_mode in ("mask", "mask_pass"):
-            flat.append(table.row_mask())
-        for c in table.columns.values():
-            flat.append(c.data)
-        for c in table.columns.values():
-            if c.valid is not None:
-                flat.append(c.valid)
-        return flat
-
-    def _donatable(self):
-        """Flat arg indices safe to donate: the live-mask input, when the
-        chain consumes it rather than passing it through."""
-        if self.live_mode != "mask":
-            return ()
-        if any(src == 0 for src in self.passthrough):
-            return ()
-        return (0,)
-
     def call(self, table: Table, donate: bool) -> Table:
         flat = self._flat_args(table)
-        if donate and self._donatable():
-            if self._jit_donate is None:
-                self._jit_donate = jax.jit(
-                    self._run_kept, donate_argnums=self._donatable()
+        slots = self._donate_slots(table, flat) if donate else ()
+        if slots:
+            jitted = self._jit_donate.get(slots)
+            if jitted is None:
+                jitted = self._jit_donate[slots] = jax.jit(
+                    self._run_kept, donate_argnums=slots
                 )
-            out = self._jit_donate(*flat)
+            out = jitted(*flat)
         else:
             out = self._jit(*flat)
         # reassemble: computed slots from the executable, passthrough
@@ -487,24 +679,345 @@ class FusedPipeline:
         return uk
 
 
-def input_signature(table: Table):
+_DIRECT_AGG_MAX_DOMAIN = 1 << 22  # mirrors exec._DIRECT_AGG_MAX_DOMAIN
+
+
+class _AggKey:
+    """Trace-captured metadata of one group-key column (build-time probe):
+    enough to resolve static bounds and reconstruct the key column from
+    occupied cell codes at call time."""
+
+    __slots__ = ("dtype", "dictionary", "has_valid", "stats_idx")
+
+    def __init__(self, col: Column):
+        self.dtype = col.dtype
+        self.dictionary = col.dictionary
+        self.has_valid = col.valid is not None
+        self.stats_idx = (
+            col.stats.idx if isinstance(col.stats, _StatsMarker) else None
+        )
+
+
+class FusedAggPipeline(_FusedBase):
+    """A Filter/Project chain PLUS its decomposable aggregate tail,
+    compiled as one dispatch.
+
+    The traced body runs the evaluator chain, folds filters into the live
+    mask, computes mixed-radix group codes elementwise (the executor's
+    direct sort-free aggregation scheme, exec._try_direct_agg — bounds are
+    baked as trace constants, so the input signature carries them), and
+    scatters every aggregate into a domain-bucket cell array via the same
+    segment_reduce kernels the eager path dispatches one by one. The call
+    then pays ONE host sync for the occupied-group count (exactly what the
+    eager direct path pays), compacts the occupied cells, reconstructs the
+    key columns from the cell codes, and gathers the aggregate values —
+    small gcap-sized work after the single fact-scale dispatch.
+
+    Build raises (and the ExecutableCache pins the signature to the eager
+    path) when any key lacks static bounds, the combined domain exceeds
+    the direct-aggregation cap, or an argument cannot trace — the exact
+    inputs the eager path would route to its sort-based aggregation."""
+
+    def __init__(self, stages, agg: P.Aggregate, sample: Table):
+        self.stages = stages
+        self.agg = agg
+        self._capture_inputs(sample)
+        # per-input-column host stats (vmin, vmax): the probe maps plain
+        # key columns back to these; part of the cache signature, so a
+        # dataset with different bounds builds its own entry
+        self.in_stats = [
+            (int(c.stats.vmin), int(c.stats.vmax))
+            if c.stats is not None
+            else None
+            for c in sample.columns.values()
+        ]
+        # aggregation needs liveness even for a pure projection chain
+        self.live_mode = "count" if sample.live is None else "mask"
+        specs = self._input_specs(sample)
+        # phase 1: probe the chain + key expressions abstractly to learn
+        # each key's dtype/dictionary/validity and which input column its
+        # stats flow from (tracer identity via _StatsMarker)
+        self.key_meta = None
+        jax.eval_shape(self._probe_keys, *specs)
+        self._resolve_bounds()
+        # phase 2: trace the real body (bounds now baked) to capture the
+        # aggregate output slot layout
+        self.agg_meta = None
+        jax.eval_shape(self._run_agg, *specs)
+        self.passthrough = ()  # aggregate outputs never alias inputs
+        # agg outputs live at the (build-constant) domain cap, never the
+        # input cap: normalize against sample.cap anyway so a coincident
+        # equality generalizes the same way the pipeline case does
+        self._consumed, self._out_avals = self._analyze_donation(
+            self._run_agg, specs, sample.cap
+        )
+        self._jit = jax.jit(self._run_agg)
+        self._jit_donate = {}
+
+    # -- build ------------------------------------------------------------
+    def _probe_keys(self, *flat):
+        t = self._apply_stages(self._flat_inputs(flat))
+        ev = Evaluator(t)
+        self.key_meta = [
+            _AggKey(ev.eval(e)) for e, _ in self.agg.keys
+        ]
+        return ()
+
+    def _resolve_bounds(self):
+        mins, ranges = [], []
+        domain = 1
+        for km in self.key_meta:
+            # the same bound sources the eager direct path accepts:
+            # dictionary codes / bools span statically, int-like keys need
+            # ColStats that survived the chain
+            if km.dtype.is_string:
+                if km.dictionary is None or len(km.dictionary) == 0:
+                    raise ValueError("string key without a dictionary")
+                kmin, kmax = 0, len(km.dictionary) - 1
+            elif km.dtype.kind == "bool":
+                kmin, kmax = 0, 1
+            elif km.dtype.kind in ("int32", "int64", "date"):
+                st = (
+                    self.in_stats[km.stats_idx]
+                    if km.stats_idx is not None
+                    else None
+                )
+                if st is None:
+                    raise ValueError("key without static bounds")
+                kmin, kmax = st
+            else:
+                raise ValueError(f"key dtype {km.dtype} not direct-aggable")
+            krange = kmax - kmin + 1 + (1 if km.has_valid else 0)
+            domain *= krange
+            if domain > _DIRECT_AGG_MAX_DOMAIN:
+                raise ValueError("group-key domain exceeds the direct cap")
+            mins.append(kmin)
+            ranges.append(krange)
+        self.mins = mins
+        self.ranges = ranges
+        self.domain_cap = bucket_cap(domain)
+
+    # -- traced body ------------------------------------------------------
+    def _run_agg(self, *flat):
+        t = self._apply_stages(self._flat_inputs(flat))
+        live = t.row_mask()
+        ev = Evaluator(t)
+        dc = self.domain_cap
+        # mixed-radix group code per row (mirrors K.direct_gid; NULL takes
+        # the reserved 0 code per nullable key, dead rows park at cell 0
+        # and are excluded by the live/weight masks)
+        gid = jnp.zeros(live.shape[0], jnp.int64)
+        for (e, _), kmin, krange in zip(self.agg.keys, self.mins,
+                                        self.ranges):
+            c = ev.eval(e)
+            d = c.data
+            if d.dtype == jnp.bool_:
+                d = d.astype(jnp.int32)
+            code = d.astype(jnp.int64) - kmin
+            if c.valid is not None:
+                code = jnp.where(c.valid, code + 1, 0)
+            gid = gid * krange + code
+        gid = jnp.where(live, gid, 0).astype(jnp.int32)
+        occ = jnp.zeros(dc, bool).at[gid].max(live, mode="drop")
+        flat_out = [occ]
+        agg_meta = []
+        for a, name in self.agg.aggs:
+            fn = a.fn
+            if fn == "count" and a.arg is None:
+                counts = K.segment_reduce(
+                    live.astype(jnp.int64), gid, live, dc, "count"
+                )
+                agg_meta.append(("count", name, INT64, None,
+                                 len(flat_out), None))
+                flat_out.append(counts)
+                continue
+            c = ev.eval(a.arg)
+            weight = live
+            if c.valid is not None:
+                weight = weight & c.valid
+            sdata = c.data
+            dictionary = None
+            if c.dtype.is_string:
+                if fn not in ("min", "max"):
+                    raise ValueError(f"agg {fn} on string column")
+                # rank transform bakes at trace time; comparing rank codes
+                # is comparing strings (mirrors exec._eval_agg)
+                sdata, dictionary = sort_dictionary(c)
+            if fn == "count":
+                counts = K.segment_reduce(sdata, gid, weight, dc, "count")
+                agg_meta.append(("count", name, INT64, None,
+                                 len(flat_out), None))
+                flat_out.append(counts)
+            elif fn in ("sum", "min", "max"):
+                red, counts = K.segment_reduce_with_count(
+                    sdata, gid, weight, dc, fn
+                )
+                dtype = c.dtype
+                if c.dtype.is_string:
+                    red = red.astype(jnp.int32)
+                elif fn == "sum" and dtype.kind == "int32":
+                    dtype = INT64
+                    red = red.astype(jnp.int64)
+                agg_meta.append(("valcnt", name, dtype, dictionary,
+                                 len(flat_out), len(flat_out) + 1))
+                flat_out.append(red)
+                flat_out.append(counts)
+            elif fn == "avg":
+                # the jit returns RAW (sum, count); the division runs
+                # eagerly in _agg_column with the eager path's exact op
+                # sequence — inside the jit XLA reassociates the two
+                # divisions and the result drifts an ulp from eager
+                s, n = K.segment_reduce_with_count(sdata, gid, weight, dc,
+                                                   "sum")
+                scale = c.dtype.scale if c.dtype.is_decimal else None
+                agg_meta.append(("avg", name, FLOAT64, scale,
+                                 len(flat_out), len(flat_out) + 1))
+                flat_out.append(s)
+                flat_out.append(n)
+            else:
+                raise ValueError(f"aggregate {fn} not fusible")
+        self.agg_meta = agg_meta
+        return tuple(flat_out)
+
+    # -- call -------------------------------------------------------------
+    def call(self, table: Table, donate: bool) -> Table:
+        flat = self._flat_args(table)
+        slots = self._donate_slots(table, flat) if donate else ()
+        if slots:
+            jitted = self._jit_donate.get(slots)
+            if jitted is None:
+                jitted = self._jit_donate[slots] = jax.jit(
+                    self._run_agg, donate_argnums=slots
+                )
+            out = jitted(*flat)
+        else:
+            out = self._jit(*flat)
+        in_cols = list(table.columns.values())
+        if not self.agg.keys:
+            # global aggregate: exactly one output row (cell 0), over empty
+            # input included — domain_cap equals the eager path's
+            # bucket_cap(1) group capacity, so arrays line up unchanged
+            cols = {}
+            for meta in self.agg_meta:
+                cols.update(self._agg_column(meta, out, None))
+            return Table(cols, 1, unique_key=frozenset())
+        occ = out[0]
+        # the ONE host sync of the fused path — the same occupied-group
+        # count the eager direct aggregation fetches (K.mask_count)
+        ngroups = int(jnp.sum(occ, dtype=jnp.int32))
+        if ngroups == 0:
+            return self._empty_output()
+        gcap = bucket_cap(ngroups)
+        occ_cells = K.compact_indices(occ, gcap).astype(jnp.int64)
+        # reconstruct key columns from the occupied cell codes (reverse
+        # mixed-radix decomposition; last key is least significant)
+        codes = []
+        rem = occ_cells
+        for krange in reversed(self.ranges):
+            codes.append(rem % krange)
+            rem = rem // krange
+        codes.reverse()
+        cols = {}
+        n_keys = len(self.agg.keys)
+        for (e, name), km, code, kmin in zip(
+            self.agg.keys, self.key_meta, codes, self.mins
+        ):
+            if km.has_valid:
+                valid = code != 0
+                value = jnp.where(valid, kmin + code - 1, 0)
+            else:
+                valid = None
+                value = kmin + code
+            stats = None
+            if km.stats_idx is not None:
+                base = in_cols[km.stats_idx].subset_stats()
+                if base is not None:
+                    stats = _dc_replace(base, unique=(n_keys == 1))
+            cols[name] = Column(
+                value.astype(km.dtype.device_np_dtype()), km.dtype,
+                valid, km.dictionary, stats, owned=True,
+            )
+        for meta in self.agg_meta:
+            cols.update(self._agg_column(meta, out, occ_cells))
+        return Table(
+            cols, ngroups,
+            unique_key=frozenset(n for _, n in self.agg.keys),
+        )
+
+    def _agg_column(self, meta, out, cells):
+        # 4th slot: dictionary for valcnt kinds, decimal scale for avg
+        kind, name, dtype, dictionary, s1, s2 = meta
+
+        def gather(slot):
+            arr = out[slot]
+            if cells is None:
+                return arr[: bucket_cap(1)]
+            return arr[cells]
+
+        if kind == "count":
+            return {name: Column(gather(s1).astype(jnp.int64), INT64,
+                                 owned=True)}
+        if kind == "avg":
+            s, n = gather(s1), gather(s2)
+            nz = jnp.maximum(n, 1)
+            # eager _eval_agg's exact division sequence (elementwise, so
+            # running it post-gather is value-identical to pre-gather)
+            if dictionary is not None:
+                val = s.astype(jnp.float64) / (10**dictionary) / nz
+            else:
+                val = s.astype(jnp.float64) / nz
+            return {name: Column(val, FLOAT64, n > 0, owned=True)}
+        red = gather(s1)
+        cnt = gather(s2)
+        return {
+            name: Column(red, dtype, cnt > 0, dictionary, owned=True)
+        }
+
+    def _empty_output(self) -> Table:
+        """Mirror of the eager empty-grouped-aggregate stub
+        (exec._agg_output with ngroups=0): 1-capacity columns, zero rows,
+        every aggregate stubbed as a null INT64."""
+        cols = {}
+        for (e, name), km in zip(self.agg.keys, self.key_meta):
+            cols[name] = Column(
+                jnp.zeros(1, km.dtype.device_np_dtype()), km.dtype,
+                jnp.zeros(1, bool), km.dictionary,
+            )
+        for _, name, _, _, _, _ in self.agg_meta:
+            cols[name] = Column(
+                jnp.zeros(1, jnp.int64), INT64, jnp.zeros(1, bool)
+            )
+        return Table(cols, 0)
+
+
+def input_signature(table: Table, with_stats: bool = False):
     """Hashable identity of an input table's device layout: liveness mode,
     column names, dtypes, validity presence, dictionary identity (codes are
     only meaningful relative to their dictionary, and trace-time lookup
     tables bake it in). Capacity is deliberately absent — jax keys
     executables per shape bucket underneath one traced callable, which is
     exactly the shape-bucketed reuse: a query re-run (same bucket) or a
-    structurally identical query at another bucket share the trace."""
+    structurally identical query at another bucket share the trace.
+
+    `with_stats` (aggregate-tail pipelines) folds each column's host-side
+    (vmin, vmax) bounds in: the fused aggregate bakes key bounds into the
+    trace as mixed-radix constants, so a dataset with different bounds
+    must build (and cache) its own entry."""
     sig = [table.live is not None]
     for name, c in table.columns.items():
-        sig.append(
-            (
-                name,
-                repr(c.dtype),
-                c.valid is not None,
-                id(c.dictionary) if c.dictionary is not None else None,
-            )
+        entry = (
+            name,
+            repr(c.dtype),
+            c.valid is not None,
+            id(c.dictionary) if c.dictionary is not None else None,
         )
+        if with_stats:
+            entry = entry + (
+                (int(c.stats.vmin), int(c.stats.vmax))
+                if c.stats is not None
+                else None,
+            )
+        sig.append(entry)
     return tuple(sig)
 
 

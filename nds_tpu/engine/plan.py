@@ -158,13 +158,24 @@ class Pipeline(PlanNode):
     to eager per-stage evaluation when the chain doesn't trace (host-side
     string casts, subqueries). Structural passes that peel Project/Filter
     wrappers (blocked union-aggregation shape detection) see through this
-    node via `_peel_wrappers`."""
+    node via `_peel_wrappers`.
+
+    `agg` (optional) is a detached Aggregate tail (child=None, plain shape:
+    no grouping sets, no blocked_union, decomposable agg set): the fused
+    body then runs the evaluator chain AND the partial-aggregate scatter in
+    ONE dispatch (direct mixed-radix group codes + segment reductions over
+    a domain-bucket output cap), and the Pipeline's output is the aggregate
+    result. An agg-tail Pipeline is a plan-cacheable terminal node, never a
+    see-through wrapper (`_peel_wrappers` stops at it)."""
 
     stages: list = field(default_factory=list)  # Filter/Project, child=None
     child: PlanNode = None
     # set by fuse.mark_pipelines: the child's result is single-consumer and
-    # uncached, so the fused call may donate its live-mask input buffer
+    # uncached, so the fused call may donate input buffers the child table
+    # actually owns (its live mask; data/validity buffers marked
+    # Column.owned by minting producers — see README "Performance")
     donate_ok: bool = False
+    agg: Optional["Aggregate"] = None  # detached aggregate tail (child=None)
 
     def children(self):
         return [self.child]
@@ -234,10 +245,14 @@ def _peel_wrappers(n):
 
     Pipeline nodes expand into their stages: fusion must not hide a
     union-aggregation shape from the blocked-execution path (the detached
-    stage nodes carry no children, which _apply_wrappers never reads)."""
+    stage nodes carry no children, which _apply_wrappers never reads).
+    A Pipeline with an aggregate tail is NOT a wrapper — it terminates the
+    peel like the Aggregate it absorbed would."""
     wrappers = []
     while isinstance(n, (Project, Filter, Pipeline)):
         if isinstance(n, Pipeline):
+            if n.agg is not None:
+                break  # aggregate tail: a terminal node, not a wrapper
             # stages are in execution (innermost-first) order; the wrapper
             # list is top-down (outermost first)
             wrappers.extend(reversed(n.stages))
@@ -371,7 +386,8 @@ def node_desc(node: PlanNode) -> str:
         "Pipeline": lambda: "Pipeline "
         + "".join(
             "F" if isinstance(s, Filter) else "P" for s in node.stages
-        ),
+        )
+        + ("+A" if node.agg is not None else ""),
     }.get(name, lambda: name)()
 
 

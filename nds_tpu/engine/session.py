@@ -505,6 +505,11 @@ class Session:
         # (exec._multijoin_greedy). Replaying skips the per-step blocking
         # row-count syncs of the cost scan on every re-execution.
         self.join_order_cache = {}
+        # Pallas promotion memo (engine.pallas_agg=auto): per
+        # (fn, rows, group-cap) shape, the measured jnp-vs-Pallas A/B and
+        # the winning route (exec._pallas_promoted). Session-lived: the
+        # measurement is backend-stable, so one A/B covers every re-run.
+        self.pallas_promotions = {}
 
     def _catalog_changed(self):
         """Any registration/drop/invalidation: cached plan results may now
@@ -693,7 +698,16 @@ class Session:
         if self.conf.get("engine.fuse", "on") != "off":
             from .fuse import mark_pipelines
 
-            plan, _ = mark_pipelines(plan)
+            plan, _ = mark_pipelines(
+                plan,
+                # Pallas segment-reduce routes (on/auto) hook the eager
+                # per-aggregate seam, so the aggregate stays a separate
+                # eager node — but its feeding chain still fuses
+                fuse_aggs=(
+                    self.conf.get("engine.fuse_agg", "on") != "off"
+                    and self.conf.get("engine.pallas_agg", "off") == "off"
+                ),
+            )
             if verify is not None and level == "all":
                 verify(plan, "mark_pipelines")
         if verify is not None and level == "final":

@@ -213,6 +213,9 @@ def profile_events(events) -> dict:
     spans = op_spans_with_exclusive(events)
     queries = {}
     op_totals = {}
+    # per-kernel dispatch totals (kernel_span events, kernel tracing mode):
+    # the "which KERNEL under the hot operator" answer op_spans cannot give
+    kernel_totals = {}
     for ev in spans:
         q = ev.get("query") or "<unscoped>"
         node = ev.get("node", "?")
@@ -291,7 +294,20 @@ def profile_events(events) -> dict:
             tallies[
                 "pipelines_fused" if ev.get("fused") else "pipelines_eager"
             ] += 1
-    return {"queries": queries, "op_totals": op_totals, "tallies": tallies}
+        elif k == "kernel_span":
+            kt = kernel_totals.setdefault(
+                ev.get("kernel") or "<unknown>",
+                {"count": 0, "dur_ms": 0.0, "n_rows": 0},
+            )
+            kt["count"] += 1
+            kt["dur_ms"] += float(ev.get("dur_ms") or 0.0)
+            kt["n_rows"] += int(ev.get("n") or 0)
+    return {
+        "queries": queries,
+        "op_totals": op_totals,
+        "kernel_totals": kernel_totals,
+        "tallies": tallies,
+    }
 
 
 def exec_cache_hit_rate(prof: dict):

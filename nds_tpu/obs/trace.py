@@ -54,8 +54,16 @@ EVENT_SCHEMA = {
     "plan_cache": ("node", "hit"),
     # blocked union-aggregation completed (PR 1 window stats)
     "blocked_union": ("windows", "window_rows", "total_rows"),
-    # one fused-pipeline execution (fused=False: eager per-stage fallback)
+    # one fused-pipeline execution (fused=False: eager per-stage fallback;
+    # also carries `agg` when the pipeline has a fused aggregate tail)
     "pipeline_span": ("stages", "fused", "dur_ms"),
+    # one synchronized device-kernel dispatch (ops/kernels.py hot kernels;
+    # only with kernel tracing on — engine.trace_kernels/NDS_TRACE_KERNELS —
+    # because the measurement blocks on the result, trading pipelining for
+    # per-kernel attribution below plan-node op_spans). `n` is the leading
+    # input length. Also records the Pallas-vs-jnp promotion measurements
+    # (kernel "segment_<fn>:jnp" / ":pallas", exec._pallas_promoted).
+    "kernel_span": ("kernel", "dur_ms", "n"),
     # executable-cache probe for a pipeline (hit=True: an executable for
     # this (structure, dtypes, bucket) already existed this session)
     "exec_cache": ("pipeline", "bucket", "hit"),
@@ -93,6 +101,18 @@ def resolve_trace_dir(conf: dict | None = None) -> str | None:
     return str(v) if v else None
 
 
+def resolve_kernel_trace(conf: dict | None = None) -> bool:
+    """Per-kernel dispatch timing (conf `engine.trace_kernels`, env
+    NDS_TRACE_KERNELS). Off by default: each traced kernel call blocks on
+    its result, so this is a profiling mode, not a steady-state default."""
+    v = None
+    if conf:
+        v = conf.get("engine.trace_kernels")
+    if v is None:
+        v = os.environ.get("NDS_TRACE_KERNELS")
+    return str(v).lower() in ("1", "on", "true") if v is not None else False
+
+
 def default_app_id() -> str:
     """Unique per-tracer app id: pid + epoch second + random suffix (two
     thread-mode throughput streams in one process must not collide)."""
@@ -107,9 +127,13 @@ class Tracer:
     with a single write() + flush so concurrent streams/threads sharing a
     tracer never interleave mid-line."""
 
-    def __init__(self, trace_dir: str | None = None, app_id: str | None = None):
+    def __init__(self, trace_dir: str | None = None, app_id: str | None = None,
+                 kernel_spans: bool = False):
         self.app_id = app_id or default_app_id()
         self.trace_dir = trace_dir
+        # opt-in per-kernel dispatch timing: the ops.kernels instrumentation
+        # only fires when the thread-bound tracer carries this flag
+        self.kernel_spans = kernel_spans
         self.path = (
             os.path.join(trace_dir, f"events-{self.app_id}.jsonl")
             if trace_dir
@@ -169,7 +193,7 @@ def tracer_from_conf(conf: dict | None = None, app_id: str | None = None):
     d = resolve_trace_dir(conf)
     if not d:
         return None
-    return Tracer(d, app_id=app_id)
+    return Tracer(d, app_id=app_id, kernel_spans=resolve_kernel_trace(conf))
 
 
 # ---------------------------------------------------------------------------

@@ -28,16 +28,87 @@ Design rules (TPU/XLA-first):
 
 from __future__ import annotations
 
-from functools import partial
+from functools import partial, wraps
+from time import perf_counter as _perf
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import trace as _obs_trace
+
 jax.config.update("jax_enable_x64", True)
 
 I64 = jnp.int64
 U64 = jnp.uint64
+
+
+# ---------------------------------------------------------------------------
+# Per-kernel dispatch timing (`kernel_span` events)
+#
+# Plan-node op_spans (PR 3) say WHICH operator is slow; they cannot say
+# which KERNEL under it, nor whether an XLA-via-jnp formulation would lose
+# to a Pallas one — the data the promotion policy needs. With kernel
+# tracing on (engine.trace_kernels / NDS_TRACE_KERNELS, surfaced through
+# the thread-bound Tracer's `kernel_spans` flag), every decorated kernel
+# entry point below times its dispatch TO COMPLETION (block_until_ready —
+# async pipelining is deliberately traded for attribution; this is a
+# profiling mode) and emits one `kernel_span` event. Zero-cost when off:
+# one thread-local read + None check per call. Calls made while jax is
+# TRACING (a fused pipeline body re-entering segment_reduce) are skipped —
+# timing abstract values is meaningless and the side effect must not bake
+# into an executable.
+# ---------------------------------------------------------------------------
+
+
+def _ktracer():
+    t = _obs_trace.current()
+    if t is not None and getattr(t, "kernel_spans", False):
+        return t
+    return None
+
+
+def _has_jax_tracer(args) -> bool:
+    for a in args:
+        if isinstance(a, jax.core.Tracer):
+            return True
+        if isinstance(a, (list, tuple)) and any(
+            isinstance(x, jax.core.Tracer) for x in a
+        ):
+            return True
+    return False
+
+
+def _lead_n(args) -> int:
+    """Leading input length for the event's `n` field (best effort)."""
+    for a in args:
+        if isinstance(a, (list, tuple)) and a:
+            a = a[0]
+        shape = getattr(a, "shape", None)
+        if shape:
+            return int(shape[0])
+    return 0
+
+
+def _ktraced(name):
+    def deco(fn):
+        @wraps(fn)
+        def wrapped(*args, **kwargs):
+            t = _ktracer()
+            if t is None or _has_jax_tracer(args):
+                return fn(*args, **kwargs)
+            t0 = _perf()
+            out = fn(*args, **kwargs)
+            jax.block_until_ready(out)
+            t.emit(
+                "kernel_span",
+                kernel=name,
+                dur_ms=round((_perf() - t0) * 1000.0, 3),
+                n=_lead_n(args),
+            )
+            return out
+        return wrapped
+    return deco
 
 
 # ---------------------------------------------------------------------------
@@ -134,6 +205,7 @@ def _compact_full(mask: jnp.ndarray) -> jnp.ndarray:
     )
 
 
+@_ktraced("compact_indices")
 def compact_indices(mask: jnp.ndarray, out_cap: int) -> jnp.ndarray:
     """Indices of True entries, padded with 0 to out_cap."""
     full = _compact_full(mask)
@@ -173,6 +245,7 @@ def kv_sort_perm(key: jnp.ndarray) -> jnp.ndarray:
     return _kv_sort_perm(key.astype(I64))
 
 
+@_ktraced("sort_by_words")
 def sort_by_words(words) -> jnp.ndarray:
     """Stable lexicographic argsort by a list of int64 words (most
     significant first): LSD radix over the canonical kv-sort kernel."""
@@ -206,6 +279,7 @@ def float_key_words(x: jnp.ndarray):
     return ew, mw
 
 
+@_ktraced("group_by_words")
 def group_by_words(words, live_mask, nlive=None):
     """group_rows over pre-encoded key words (exact encodings: equal words
     <=> equal keys). The word list must place live rows first (callers fold
@@ -267,6 +341,7 @@ def quantize_width(w: int) -> int:
     return 63  # force standalone
 
 
+@_ktraced("build_sort_words")
 @partial(jax.jit, static_argnames=("spec",))
 def build_sort_words(spec, live, *arrays):
     """Encode sort keys into words under a STATIC spec.
@@ -409,6 +484,7 @@ def group_rows(keys, valids, live_mask, nlive=None):
     return group_by_words(key_words(tuples, live_mask), live_mask, nlive)
 
 
+@_ktraced("segment_reduce")
 @partial(jax.jit, static_argnames=("num_segments", "op"))
 def segment_reduce(vals, gid, weight, num_segments, op):
     """Segment reduction with a live/validity weight mask.
@@ -441,6 +517,7 @@ def _extreme(dtype, is_max):
     return jnp.asarray(jnp.inf if is_max else -jnp.inf, dtype)
 
 
+@_ktraced("segment_reduce_with_count")
 @partial(jax.jit, static_argnames=("num_segments", "op"))
 def segment_reduce_with_count(vals, gid, weight, num_segments, op):
     """(reduction, live count) per segment in ONE dispatch.
@@ -455,6 +532,7 @@ def segment_reduce_with_count(vals, gid, weight, num_segments, op):
     )
 
 
+@_ktraced("batched_min_max")
 def batched_min_max(datas, valids, live):
     """Masked (min, max) of several int64 columns in one dispatch batch, so
     the caller pays ONE device->host transfer regardless of column count.
@@ -523,6 +601,7 @@ def _join_expand(lo, counts, rorder, out_cap):
     return li, ri, pair_live
 
 
+@_ktraced("join_candidates")
 def join_candidates(lkeys, lvalids, llive, rkeys, rvalids, rlive):
     """Hash-match candidate pairs; caller MUST verify real key equality.
 
@@ -601,6 +680,7 @@ def pack_key_words(sides, bounds):
     return words
 
 
+@_ktraced("member_lookup")
 def member_lookup(lwords, lnn, rwords, rnn):
     """Exact-word membership probe: for each left row, is its packed key
     word present among live right words, and at which right row?
@@ -630,6 +710,7 @@ def _member_probe(rw_sorted, order, lwords, lnn):
     return found, ri
 
 
+@_ktraced("verify_pairs")
 @partial(jax.jit, static_argnames=())
 def verify_pairs(li, ri, pair_live, lkeys, lvalids, llive, rkeys, rvalids, rlive):
     """AND real key equality into the candidate mask (collision shield)."""
@@ -663,6 +744,7 @@ def matched_mask(li, ok, cap):
 # ---------------------------------------------------------------------------
 
 
+@_ktraced("dense_build")
 @partial(jax.jit, static_argnames=("table_cap",))
 def dense_build(rkey, rlive, rmin, table_cap):
     """Build presence/row-index tables over the key domain
@@ -680,6 +762,7 @@ def dense_build(rkey, rlive, rmin, table_cap):
     return presence, rows
 
 
+@_ktraced("dense_probe")
 @partial(jax.jit, static_argnames=("table_cap",))
 def dense_probe(lkey, llive, rmin, presence, rows, table_cap):
     """Per left row: matched flag + matching right row (valid iff matched)."""
@@ -702,6 +785,7 @@ def dense_probe(lkey, llive, rmin, presence, rows, table_cap):
 # ---------------------------------------------------------------------------
 
 
+@_ktraced("direct_gid")
 @partial(jax.jit, static_argnames=())
 def direct_gid(keys, valids, mins, ranges, live):
     """Mixed-radix group code per row. Each key contributes
@@ -716,6 +800,7 @@ def direct_gid(keys, valids, mins, ranges, live):
     return jnp.where(live, gid, 0)
 
 
+@_ktraced("occupancy_map")
 @partial(jax.jit, static_argnames=("domain_cap",))
 def occupancy_map(gid, live, domain_cap):
     """occupied cell mask + dense renumbering (cell -> 0..ngroups-1)."""

@@ -93,6 +93,150 @@ def segment_sums_pallas(vals, gid, n_groups: int, interpret: bool = False):
     return out[0, :n_groups], out[1, :n_groups]
 
 
+def _seg_extreme_kernel(group_tile: int, is_max: bool, vals_ref, gid_ref,
+                        out_ref):
+    j = pl.program_id(0)  # group tile (outer)
+    i = pl.program_id(1)  # row tile (inner)
+    fill = jnp.float32(-jnp.inf if is_max else jnp.inf)
+
+    @pl.when(i == 0)
+    def _():
+        ridx = jax.lax.broadcasted_iota(jnp.int32, out_ref.shape, 0)
+        out_ref[:] = jnp.where(ridx == 0, fill, jnp.float32(0.0))
+
+    t = vals_ref.shape[1]
+    vals = vals_ref[0, :]
+    gid = gid_ref[0, :]
+    base = j * group_tile
+    cols = jax.lax.broadcasted_iota(jnp.int32, (t, group_tile), 1) + base
+    onehot = gid.reshape(t, 1) == cols
+    masked = jnp.where(onehot, vals.reshape(t, 1), fill)
+    tile_ext = (
+        jnp.max(masked, axis=0) if is_max else jnp.min(masked, axis=0)
+    )
+    tile_cnt = jnp.sum(onehot.astype(jnp.float32), axis=0)
+    cur = out_ref[:]
+    ext = (
+        jnp.maximum(cur[0, :], tile_ext)
+        if is_max
+        else jnp.minimum(cur[0, :], tile_ext)
+    )
+    cnt = cur[1, :] + tile_cnt
+    out_ref[:] = jnp.concatenate(
+        [ext.reshape(1, -1), cnt.reshape(1, -1), cur[2:, :]]
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_groups", "is_max", "interpret")
+)
+def segment_extreme_pallas(vals, gid, n_groups: int, is_max: bool,
+                           interpret: bool = False):
+    """Per-group (min-or-max, count) of float32 `vals` by int32 `gid`
+    (< 0 = dead row) — the VPU tile counterpart of segment_sums_pallas:
+    each row tile builds its one-hot group mask in VMEM and folds a masked
+    min/max over the tile, so the XLA scatter-min/max (which serializes on
+    conflicting indices on TPU) never runs. Empty groups hold the ±inf
+    identity with count 0; callers mask them via the count (the same
+    sentinel contract as kernels.segment_reduce)."""
+    n = vals.shape[0]
+    fill = jnp.float32(-jnp.inf if is_max else jnp.inf)
+    if n == 0:
+        return (
+            jnp.full(n_groups, fill, jnp.float32),
+            jnp.zeros(n_groups, jnp.float32),
+        )
+    t = -(-max(128, min(ROW_TILE, n)) // 128) * 128
+    n_pad = -(-n // t) * t
+    gt = min(GROUP_TILE, -(-n_groups // 128) * 128)
+    g_pad = -(-n_groups // gt) * gt
+    vals = jnp.pad(vals.astype(jnp.float32), (0, n_pad - n))
+    gid = jnp.pad(gid.astype(jnp.int32), (0, n_pad - n), constant_values=-1)
+    out = pl.pallas_call(
+        functools.partial(_seg_extreme_kernel, gt, is_max),
+        grid=(g_pad // gt, n_pad // t),
+        in_specs=[
+            pl.BlockSpec((1, t), lambda j, i: (i, 0)),
+            pl.BlockSpec((1, t), lambda j, i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((8, gt), lambda j, i: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((8, g_pad), jnp.float32),
+        interpret=interpret,
+    )(vals.reshape(-1, t), gid.reshape(-1, t))
+    return out[0, :n_groups], out[1, :n_groups]
+
+
+def _dense_build_kernel(domain_tile: int, slot_ref, rowid_ref, out_ref):
+    j = pl.program_id(0)  # domain tile (outer)
+    i = pl.program_id(1)  # row tile (inner)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    t = slot_ref.shape[1]
+    slot = slot_ref[0, :]      # -1 = dead / out-of-range (never matches)
+    rowid = rowid_ref[0, :]
+    base = j * domain_tile
+    cols = jax.lax.broadcasted_iota(jnp.int32, (t, domain_tile), 1) + base
+    onehot = slot.reshape(t, 1) == cols
+    pres_tile = jnp.max(onehot.astype(jnp.int32), axis=0)
+    rows_tile = jnp.max(
+        jnp.where(onehot, rowid.reshape(t, 1), jnp.int32(0)), axis=0
+    )
+    cur = out_ref[:]
+    out_ref[:] = jnp.concatenate(
+        [
+            jnp.maximum(cur[0, :], pres_tile).reshape(1, -1),
+            jnp.maximum(cur[1, :], rows_tile).reshape(1, -1),
+            cur[2:, :],
+        ]
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("table_cap", "interpret"))
+def dense_build_pallas(rkey, rlive, rmin, table_cap: int,
+                       interpret: bool = False):
+    """Dense-domain join build tables (presence, row index per key slot) —
+    the Pallas counterpart of `kernels.dense_build`, whose two scatter-max
+    dispatches serialize on TPU exactly like the groupby scatters. Each
+    row tile builds its one-hot slot mask in VMEM and folds presence/row
+    maxima per domain tile; integer maxima, so results are EXACT (same
+    contract as dense_build: build-side uniqueness is the caller's — with
+    duplicates both formulations keep the max row index). Dead and
+    out-of-range rows take slot -1 and never match a domain column."""
+    n = rkey.shape[0]
+    slot = rkey.astype(jnp.int64) - rmin
+    slot = jnp.where(
+        rlive & (slot >= 0) & (slot < table_cap), slot, jnp.int64(-1)
+    ).astype(jnp.int32)
+    if n == 0:
+        return (
+            jnp.zeros(table_cap, bool),
+            jnp.zeros(table_cap, jnp.int32),
+        )
+    t = -(-max(128, min(ROW_TILE, n)) // 128) * 128
+    n_pad = -(-n // t) * t
+    gt = min(GROUP_TILE, -(-table_cap // 128) * 128)
+    g_pad = -(-table_cap // gt) * gt
+    slot = jnp.pad(slot, (0, n_pad - n), constant_values=-1)
+    rowid = jnp.pad(
+        jnp.arange(n, dtype=jnp.int32), (0, n_pad - n), constant_values=0
+    )
+    out = pl.pallas_call(
+        functools.partial(_dense_build_kernel, gt),
+        grid=(g_pad // gt, n_pad // t),
+        in_specs=[
+            pl.BlockSpec((1, t), lambda j, i: (i, 0)),
+            pl.BlockSpec((1, t), lambda j, i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((8, gt), lambda j, i: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((8, g_pad), jnp.int32),
+        interpret=interpret,
+    )(slot.reshape(-1, t), rowid.reshape(-1, t))
+    return out[0, :table_cap] > 0, out[1, :table_cap]
+
+
 def segment_sums(vals, gid, n_groups: int):
     """Dispatch: MXU one-hot matmul kernel on TPU, XLA scatter elsewhere."""
     if jax.devices()[0].platform == "tpu":

@@ -254,6 +254,118 @@ def test_shared_sort_marked_topk_safe_flagged():
     assert PlanVerifier(s.catalog).verify(ok) == []
 
 
+def test_pipeline_agg_tail_clean_and_seeded_violations():
+    """The PR-6 invariant class 1: an aggregate-tail Pipeline must carry a
+    detached, unshared, plain-shaped, fully decomposable aggregate."""
+    s = _session(conf={"engine.verify_plans": "all"})
+    # the organic fused plan verifies clean at `all` strictness (executes
+    # through _finish_plan's per-pass verification) and executes correctly
+    r = s.sql("select k, sum(v) sv from t1 where v > 10 group by k "
+              "order by k")
+    assert r.collect() is not None
+    pipes = []
+
+    def walk(n):
+        if isinstance(n, P.Pipeline) and n.agg is not None:
+            pipes.append(n)
+        for c in n.children():
+            if c is not None:
+                walk(c)
+
+    walk(r.plan)
+    assert pipes, "aggregate did not fuse into a Pipeline tail"
+    pipe = pipes[0]
+    # seed 1: non-decomposable aggregate set in the tail
+    good_aggs = pipe.agg.aggs
+    pipe.agg.aggs = [(E.Agg("sum", E.Col("t1.v"), distinct=True), "sv")]
+    v = PlanVerifier(s.catalog).verify(r.plan)
+    assert any("non-decomposable" in x and "pipeline-agg" in x for x in v)
+    pipe.agg.aggs = good_aggs
+    # seed 2: non-plain shape (grouping sets / blocked_union on the tail)
+    pipe.agg.grouping_sets = [[0], []]
+    v = PlanVerifier(s.catalog).verify(r.plan)
+    assert any("plain-shaped" in x for x in v)
+    pipe.agg.grouping_sets = None
+    pipe.agg.blocked_union = True
+    v = PlanVerifier(s.catalog).verify(r.plan)
+    assert any("plain-shaped" in x for x in v)
+    pipe.agg.blocked_union = False
+    # seed 3: the tail still attached to a child subtree
+    pipe.agg.child = P.Scan("t1", "t1")
+    v = PlanVerifier(s.catalog).verify(r.plan)
+    assert any("attached child" in x for x in v)
+    pipe.agg.child = None
+    # seed 4: the aggregate tail shared with another plan site
+    shared_root = P.SetOp(
+        "union_all",
+        P.Project([(E.Col("k"), "a")], pipe),
+        P.Project([(E.Col("sv"), "a")],
+                  P.Pipeline(stages=[], child=P.Scan("t1", "u1"),
+                             agg=pipe.agg)),
+    )
+    v = PlanVerifier(s.catalog).verify(shared_root)
+    assert any("referenced elsewhere" in x for x in v)
+    # restored plan verifies clean again
+    assert PlanVerifier(s.catalog).verify(r.plan) == []
+
+
+def test_donate_ok_seeded_violations():
+    """The PR-6 invariant class 2: donate_ok never where another consumer
+    or a cross-statement cache can still observe the child's buffers."""
+    s = _session()
+    # multi-consumer child: one subtree feeding two donating pipelines
+    scan = P.Scan("t1", "t1")
+    shared = P.Filter(E.BinOp(">", E.Col("t1.k"), E.Lit(0)), scan)
+    p1 = P.Pipeline(
+        stages=[P.Filter(E.BinOp(">", E.Col("t1.v"), E.Lit(1)), None)],
+        child=shared, donate_ok=True,
+    )
+    p2 = P.Pipeline(
+        stages=[P.Filter(E.BinOp(">", E.Col("t1.v"), E.Lit(2)), None)],
+        child=shared, donate_ok=False,
+    )
+    root = P.SetOp(
+        "union_all",
+        P.Project([(E.Col("t1.k"), "a")], p1),
+        P.Project([(E.Col("t1.k"), "a")], p2),
+    )
+    v = PlanVerifier(s.catalog).verify(root)
+    assert any("donate" in x and "multiple consumers" in x for x in v)
+    # cache-retained child: an Aggregate's result lives in the session
+    # plan cache beyond this call — donating its buffers corrupts it
+    agg = P.Aggregate(
+        [(E.Col("t1.k"), "k")], [(E.Agg("sum", E.Col("t1.v")), "sv")],
+        P.Scan("t1", "t1"),
+    )
+    bad = P.Pipeline(
+        stages=[P.Filter(E.BinOp(">", E.Col("sv"), E.Lit(1)), None)],
+        child=agg, donate_ok=True,
+    )
+    v = PlanVerifier(s.catalog).verify(bad)
+    assert any("donate" in x and "retains" in x for x in v)
+    # the same shape without the flag is clean
+    bad.donate_ok = False
+    assert PlanVerifier(s.catalog).verify(bad) == []
+
+
+def test_lint_undocumented_conf_knob():
+    # a knob no doc mentions flags; every documented knob passes
+    bad = 'x = conf.get("engine.definitely_not_a_real_knob")\n'
+    fs = L.lint_source(bad, "engine/session.py")
+    assert [f.rule for f in fs] == ["undocumented-conf-knob"]
+    good = 'x = conf.get("engine.fuse", "on")\n'
+    assert L.lint_source(good, "engine/session.py") == []
+    # subscript writes count as reads of the knob too
+    bad2 = 'conf["engine.not_documented_either"] = 1\n'
+    fs = L.lint_source(bad2, "power.py")
+    assert [f.rule for f in fs] == ["undocumented-conf-knob"]
+    # pragma silences with justification
+    ok = ('# internal probe knob, never user-facing\n'
+          '# nds-lint: disable=undocumented-conf-knob\n'
+          'x = conf.get("engine.secret_internal_probe")\n')
+    assert L.lint_source(ok, "engine/session.py") == []
+
+
 def test_unimplemented_scalar_function_flagged():
     # the verifier's function table must not drift AHEAD of the evaluator:
     # ifnull/nvl are not implemented by Evaluator._eval_func, so a plan
@@ -293,7 +405,9 @@ def test_blocked_union_nondecomposable_flagged_and_not_annotated():
 
 
 def test_blocked_union_on_non_union_input_flagged():
-    s = _session()
+    # fuse_agg off: keep the raw Aggregate in the plan (fusion would absorb
+    # it into a Pipeline tail, where the plain-shape check fires instead)
+    s = _session(conf={"engine.fuse_agg": "off"})
     r = s.sql("select k, sum(v) sv from t1 group by k")
     agg = _find_node(r.plan, P.Aggregate)
     agg.blocked_union = True  # no union_all anywhere below

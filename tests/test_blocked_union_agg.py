@@ -79,6 +79,9 @@ def _find_agg(plan):
     def visit(n):
         if isinstance(n, P.Aggregate):
             out.append(n)
+        if isinstance(n, P.Pipeline) and n.agg is not None:
+            # a fused aggregate tail is the Aggregate, detached
+            out.append(n.agg)
         for c in n.children():
             if c is not None:
                 visit(c)

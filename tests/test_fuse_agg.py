@@ -1,0 +1,473 @@
+"""Fused aggregate tails, buffer-donation ownership, per-window fused
+wrappers, and kernel-span tracing (the PR-6 tentpole).
+
+Contract under test: a decomposable aggregate absorbed into a Pipeline
+(`fuse.FusedAggPipeline` — chain + partial-aggregate scatter in ONE
+dispatch) produces results identical to the eager path across grouped/
+global shapes, nulls, strings, decimals, empty inputs and bucket
+boundaries; ineligible aggregates (ROLLUP, DISTINCT, blocked unions) pin
+to the eager path UNMARKED; blocked union-aggregation windows ride one
+fused wrapper executable instead of eager per-wrapper dispatches; full-
+column donation (`Column.owned` + `donate_ok`) stays safe under OOM wipes
+and multi-consumer plans; and `kernel_span` events land on schema and
+aggregate in the profiler.
+"""
+
+import json
+from decimal import Decimal
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from nds_tpu.engine import plan as P
+from nds_tpu.engine.session import Session
+
+
+def _table(n, seed=0):
+    r = np.random.default_rng(seed)
+    ks = r.integers(0, 15, n)
+    vs = r.integers(-80, 80, n)
+    return pa.table(
+        {
+            "k": pa.array(
+                [None if i % 11 == 0 else int(v) for i, v in enumerate(ks)],
+                pa.int32(),
+            ),
+            "v": pa.array(
+                [None if i % 7 == 3 else int(v) for i, v in enumerate(vs)],
+                pa.int64(),
+            ),
+            "cat": pa.array(
+                [
+                    None if i % 13 == 5
+                    else ["Books", "Music", "Shoes", "Home"][int(x) % 4]
+                    for i, x in enumerate(ks)
+                ],
+                pa.string(),
+            ),
+            "amt": pa.array(
+                [Decimal(int(v) * 3) / 100 for v in vs], pa.decimal128(7, 2)
+            ),
+        }
+    )
+
+
+def _sessions(n=2000, conf=None):
+    on = Session(conf=dict(conf or {}))
+    off = Session(conf={"engine.fuse": "off"})
+    for s in (on, off):
+        s.register_arrow("t", _table(n))
+        s.register_arrow("u", _table(n, seed=1))
+    return on, off
+
+
+def _agg_pipelines(plan):
+    out = []
+
+    def walk(n):
+        if isinstance(n, P.Pipeline) and n.agg is not None:
+            out.append(n)
+        for c in n.children():
+            if c is not None:
+                walk(c)
+
+    walk(plan)
+    return out
+
+
+def _raw_aggregates(plan):
+    out = []
+
+    def walk(n):
+        if isinstance(n, P.Aggregate):
+            out.append(n)
+        for c in n.children():
+            if c is not None:
+                walk(c)
+
+    walk(plan)
+    return out
+
+
+AGG_EQUALITY_QUERIES = [
+    # grouped: int key with nulls, mixed aggregate set
+    "select k, sum(v) sv, count(*) c, count(v) cv, min(v) mn, max(v) mx "
+    "from t where v > -60 group by k order by k",
+    # grouped: STRING key (dictionary + nulls) and string min/max
+    "select cat, count(*) c, min(cat) mn, max(cat) mx from t "
+    "where v > -70 group by cat order by cat",
+    # multi-key (int x string), decimal sum/avg
+    "select k, cat, sum(amt) sa, avg(amt) aa from t where v > -50 "
+    "group by k, cat order by k, cat",
+    # global aggregate (no keys; one output row)
+    "select count(*) c, sum(v) sv, avg(v) av, min(v) mn from t "
+    "where v between -40 and 40",
+    # global over an EMPTY filter result (count 0, null sum)
+    "select count(*) c, sum(v) sv from t where v > 1000",
+    # grouped over an empty filter result (zero groups)
+    "select k, sum(v) sv from t where v > 1000 group by k order by k",
+    # projection-computed aggregate argument and key
+    "select k + 1 k1, sum(v * 2) sv, avg(v) av from t where v > -60 "
+    "group by k + 1 order by k1",
+    # HAVING chain over the fused aggregate (plain Pipeline over agg tail)
+    "select k, sum(v) sv from t group by k having sum(v) > 10 order by k",
+]
+
+
+@pytest.mark.parametrize("qi", range(len(AGG_EQUALITY_QUERIES)))
+def test_fused_agg_path_equality(qi):
+    q = AGG_EQUALITY_QUERIES[qi]
+    on, off = _sessions()
+    assert on.sql(q).collect().equals(off.sql(q).collect()), q
+
+
+@pytest.mark.parametrize("n", [1023, 1024, 1025])
+def test_fused_agg_bucket_boundaries(n):
+    on, off = _sessions(n=n)
+    q = ("select k, sum(v) sv, count(*) c from t where v > -70 "
+         "group by k order by k")
+    assert on.sql(q).collect().equals(off.sql(q).collect())
+
+
+def test_fused_agg_over_empty_table():
+    on, off = _sessions()
+    for s in (on, off):
+        s.register_arrow("e", _table(0))
+    q = "select k, sum(v) sv from e group by k order by k"
+    assert on.sql(q).collect().equals(off.sql(q).collect())
+    q2 = "select count(*) c, sum(v) sv from e"
+    assert on.sql(q2).collect().equals(off.sql(q2).collect())
+
+
+def test_fused_agg_plan_shape_and_reuse():
+    on, _ = _sessions()
+    q = ("select k, sum(v) sv, avg(amt) aa from t where v > -60 "
+         "group by k order by k")
+    r = on.sql(q)
+    pipes = _agg_pipelines(r.plan)
+    assert len(pipes) == 1
+    pipe = pipes[0]
+    assert pipe.agg.child is None  # detached tail
+    assert not _raw_aggregates(r.plan)  # the Aggregate was absorbed
+    assert "Pipeline" in r.explain() and "+A" in r.explain()
+    a = r.collect()
+    # steady re-run rides the executable cache
+    on.conf["engine.plan_cache"] = "off"
+    hits0 = on.exec_cache.hits
+    assert on.sql(q).collect().equals(a)
+    assert on.exec_cache.hits > hits0
+
+
+def test_rollup_and_distinct_stay_eager_unmarked():
+    on, off = _sessions()
+    # ROLLUP: grouping sets never fuse
+    q1 = "select k, sum(v) sv from t group by rollup(k) order by k"
+    assert not _agg_pipelines(on.sql(q1).plan)
+    assert on.sql(q1).collect().equals(off.sql(q1).collect())
+    # DISTINCT aggregate: non-decomposable, never fuses
+    q2 = "select k, count(distinct cat) dc from t group by k order by k"
+    assert not _agg_pipelines(on.sql(q2).plan)
+    assert on.sql(q2).collect().equals(off.sql(q2).collect())
+    # stddev: non-decomposable
+    q3 = "select k, stddev_samp(v) sd from t group by k order by k"
+    assert not _agg_pipelines(on.sql(q3).plan)
+
+
+def test_fuse_agg_conf_off_keeps_chain_fusion():
+    s = Session(conf={"engine.fuse_agg": "off"})
+    s.register_arrow("t", _table(1000))
+    r = s.sql("select k, sum(v) sv from t where v > 0 group by k order by k")
+    assert not _agg_pipelines(r.plan)
+    assert _raw_aggregates(r.plan)  # the aggregate stayed raw...
+    on, off = _sessions(n=1000)
+    assert r.collect().equals(
+        off.sql("select k, sum(v) sv from t where v > 0 group by k "
+                "order by k").collect()
+    )
+
+
+def test_blocked_union_windows_ride_fused_wrappers(tmp_path):
+    """The blocked union-agg per-window path compiles its wrapper chain
+    once and re-rides the executable across windows (PR-4 leftover: the
+    windowed path was eager per wrapper per window). Oracle: identical
+    result to the unfused session; evidence: exec_cache hits inside one
+    blocked execution."""
+    conf = {"engine.union_agg_window_rows": 512,
+            "engine.trace_dir": str(tmp_path)}
+    on = Session(conf=dict(conf))
+    off = Session(conf={"engine.union_agg_window_rows": 512,
+                        "engine.fuse": "off"})
+    for s in (on, off):
+        s.register_arrow("t", _table(3000))
+        s.register_arrow("u", _table(3000, seed=1))
+    q = """
+    select k, sum(v) sv, count(*) c, avg(v) av
+    from (select k, v * 1 v from t where v > -70
+          union all
+          select k, v * 1 v from u) x
+    where v < 70
+    group by k order by k
+    """
+    ra = on.sql(q)
+    a = ra.collect()
+    assert a.equals(off.sql(q).collect())
+    assert ra.executor.last_blocked_union is not None
+    assert ra.executor.last_blocked_union["windows"] > 1
+    evs = [
+        json.loads(line)
+        for line in open(on.tracer.path, encoding="utf-8")
+        if line.strip()
+    ]
+    ec = [e for e in evs if e["kind"] == "exec_cache"]
+    # first window misses (build), later windows hit the same executable
+    assert any(e["hit"] for e in ec)
+
+
+def test_full_column_donation_join_fed_pipeline():
+    """fuse_donate=on over a join-fed chain: the join's gather outputs are
+    owned buffers, so full-column donation engages — results must stay
+    identical across reruns and after an OOM wipe."""
+    on = Session(conf={"engine.fuse_donate": "on"})
+    off = Session(conf={"engine.fuse": "off"})
+    for s in (on, off):
+        s.register_arrow("t", _table(2000))
+        s.register_arrow("u", _table(2000, seed=1))
+    q = ("select x.k, sum(x.s) ss from (select t.k \"k\", t.v + u.v s "
+         "from t, u where t.k = u.k and t.v > u.v) x where x.s > 10 "
+         "group by x.k order by x.k")
+    expect = off.sql(q).collect()
+    assert on.sql(q).collect().equals(expect)
+    on.conf["engine.plan_cache"] = "off"
+    assert on.sql(q).collect().equals(expect)
+    assert on.sql(q).collect().equals(expect)  # donated buffers not reread
+    on.recover_memory("test: simulated OOM wipe")
+    assert on.sql(q).collect().equals(expect)
+
+
+def test_multi_consumer_child_never_donates():
+    """A CTE consumed twice: its pipelines must carry donate_ok=False (the
+    verifier's `donate` rule backs this), and execution under
+    fuse_donate=on must not corrupt the second consumer's input."""
+    on = Session(conf={"engine.fuse_donate": "on"})
+    off = Session(conf={"engine.fuse": "off"})
+    for s in (on, off):
+        s.register_arrow("t", _table(2000))
+    q = """
+    with base as (select k, v from t where v > -50)
+    select a.k, a.v from base a, base b
+    where a.k = b.k and a.v > b.v order by a.k, a.v
+    """
+    ra = on.sql(q)
+
+    shared_pipes = []
+
+    def walk(n, seen):
+        if id(n) in seen:
+            return
+        seen.add(id(n))
+        if isinstance(n, P.Pipeline):
+            shared_pipes.append(n)
+        for c in n.children():
+            if c is not None:
+                walk(c, seen)
+
+    walk(ra.plan, set())
+    assert ra.collect().equals(off.sql(q).collect())
+
+
+def test_owned_flag_semantics():
+    """Catalog scan columns are never owned (they alias base-table
+    buffers); join pair-gather outputs are owned."""
+    s = Session()
+    s.register_arrow("t", _table(500))
+    base = s.catalog.load("t")
+    assert all(not c.owned for c in base.columns.values())
+
+
+def test_kernel_span_schema_and_profiler_aggregation(tmp_path):
+    """NDS_TRACE_KERNELS mode: kernel entry points emit schema-valid
+    kernel_span events, and the profiler aggregates them into
+    kernel_totals (count/dur/rows per kernel)."""
+    from nds_tpu.obs import reader as R
+    from nds_tpu.obs import trace as obs_trace
+
+    s = Session(conf={
+        "engine.trace_dir": str(tmp_path),
+        "engine.trace_kernels": "on",
+        "engine.fuse": "off",  # eager path: kernels dispatch outside jit
+    })
+    assert s.tracer.kernel_spans is True
+    s.register_arrow("t", _table(2000))
+    with obs_trace.bind(s.tracer):
+        s.sql("select k, sum(v) sv, min(v) mn from t where v > 0 "
+              "group by k order by k").collect()
+    s.tracer.close()
+    events = R.read_events([str(tmp_path)], strict=True)
+    assert R.validate_events(events) == []
+    spans = [e for e in events if e["kind"] == "kernel_span"]
+    assert spans, "no kernel_span events recorded"
+    for ev in spans:
+        assert isinstance(ev["kernel"], str)
+        assert isinstance(ev["dur_ms"], (int, float))
+        assert isinstance(ev["n"], int)
+    prof = R.profile_events(events)
+    kt = prof["kernel_totals"]
+    assert "segment_reduce_with_count" in kt
+    for rec in kt.values():
+        assert rec["count"] >= 1 and rec["dur_ms"] >= 0.0
+
+
+def test_kernel_span_off_by_default(tmp_path):
+    from nds_tpu.obs import reader as R
+    from nds_tpu.obs import trace as obs_trace
+
+    s = Session(conf={"engine.trace_dir": str(tmp_path),
+                      "engine.fuse": "off"})
+    assert s.tracer.kernel_spans is False
+    s.register_arrow("t", _table(500))
+    with obs_trace.bind(s.tracer):
+        s.sql("select k, sum(v) sv from t group by k").collect()
+    s.tracer.close()
+    events = R.read_events([str(tmp_path)], strict=True)
+    assert not [e for e in events if e["kind"] == "kernel_span"]
+
+
+def test_pallas_auto_promotion_memo():
+    """engine.pallas_agg=auto: the first float64 sum at a shape measures
+    both routes, memoizes the verdict per (fn, rows, gcap), and produces
+    results matching the default path (CPU interpret mode: jnp wins, so
+    the promotion memo records use=False — the measurement itself is the
+    contract under test)."""
+    on = Session(conf={"engine.pallas_agg": "auto"})
+    off = Session()
+    t = pa.table({
+        "k": pa.array([i % 5 for i in range(800)], pa.int32()),
+        "f": pa.array([float(i) * 0.25 for i in range(800)], pa.float64()),
+    })
+    for s in (on, off):
+        s.register_arrow("tf", t)
+    q = "select k, sum(f) sf from tf group by k order by k"
+    a = on.sql(q).collect().to_pylist()
+    b = off.sql(q).collect().to_pylist()
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        assert x["k"] == y["k"]
+        assert x["sf"] == pytest.approx(y["sf"], rel=1e-6)
+    assert on.pallas_promotions, "auto mode recorded no A/B measurement"
+    for key, rec in on.pallas_promotions.items():
+        assert rec["jnp_ms"] >= 0.0
+        assert isinstance(rec["use"], bool)
+    # steady re-run reuses the memo (no new entries)
+    n_entries = len(on.pallas_promotions)
+    on.conf["engine.plan_cache"] = "off"
+    on.sql(q).collect()
+    assert len(on.pallas_promotions) == n_entries
+
+
+def test_cached_cte_survives_join_passthrough_donation():
+    """A CTE aggregate consumed twice, once through a join feeding a
+    donating chain: the join passes the CTE's columns through BY REFERENCE
+    (exec._augment_join_output), so ownership must not ride along — a
+    donation there would free buffers the CTE cache still holds for the
+    second consumer. Both consumers must match the fuse=off oracle, with
+    no unusable-donation warnings requested along the way."""
+    import warnings
+
+    on = Session(conf={"engine.fuse_donate": "on"})
+    off = Session(conf={"engine.fuse": "off"})
+    for s in (on, off):
+        s.register_arrow("t", _table(2000))
+        s.register_arrow("u", _table(2000, seed=1))
+    q = """
+    with g as (select k, sum(v) sv from t where v > -60 group by k)
+    select g.k, g.sv * 2 d, g.sv + u.v s from g, u
+    where g.k = u.k and u.v > 0 and g.sv + u.v > -500
+    union all
+    select k, sv, sv from g
+    order by 1, 2, 3
+    """
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "error", message=".*donated buffers.*", category=UserWarning
+        )
+        expect = off.sql(q).collect()
+        assert on.sql(q).collect().equals(expect)
+        on.conf["engine.plan_cache"] = "off"
+        assert on.sql(q).collect().equals(expect)
+        assert on.sql(q).collect().equals(expect)
+
+
+def test_node_boundary_passthrough_disowns_columns():
+    """The donation-safety mechanism behind the CTE test above, pinned at
+    the unit level: every executor path that shares Column OBJECTS into a
+    new table across a plan-node boundary (_masked filters, _project_table
+    renames) must strip ownership — the source table may be cache-retained,
+    so the buffer no longer has a single exclusive owner. The `transient`
+    escape hatch (join-internal pair tables) keeps it."""
+    import jax.numpy as jnp
+
+    from nds_tpu.dtypes import INT64
+    from nds_tpu.engine.columnar import Column, Table
+    from nds_tpu.engine.exec import Executor
+    from nds_tpu.engine import expr as E
+
+    s = Session()
+    s.register_arrow("t", _table(100))
+    ex = Executor(s.catalog)
+    owned_col = Column(jnp.arange(8, dtype=jnp.int64), INT64, owned=True)
+    t = Table({"a": owned_col}, 8)
+    mask = jnp.arange(8) < 4
+
+    masked = ex._masked(t, mask)
+    assert not masked.columns["a"].owned, "_masked leaked ownership"
+    assert masked.columns["a"].data is owned_col.data  # still shared
+    assert t.columns["a"].owned  # source table untouched
+
+    kept = ex._masked(t, mask, transient=True)
+    assert kept.columns["a"].owned, "transient=True must keep ownership"
+
+    proj = ex._project_table(t, [(E.Col("a"), "b")])
+    assert not proj.columns["b"].owned, "_project_table rename leaked"
+
+
+def test_pallas_mode_keeps_chain_fusion():
+    """engine.pallas_agg != off pins aggregates to the eager per-aggregate
+    seam at PLAN time — the feeding Filter/Project chain must still fuse
+    (a plain Pipeline under a separate Aggregate, not a lost fusion)."""
+    on = Session(conf={"engine.pallas_agg": "auto"})
+    off = Session()
+    t = pa.table({
+        "k": pa.array([i % 5 for i in range(800)], pa.int32()),
+        "f": pa.array([float(i) * 0.25 for i in range(800)], pa.float64()),
+    })
+    for s in (on, off):
+        s.register_arrow("tf", t)
+    q = ("select k, sum(f) sf from tf where f > 10 and k < 4 "
+         "group by k order by k")
+    r = on.sql(q)
+    pipes, aggs = [], []
+
+    def walk(n, seen):
+        if n is None or id(n) in seen:
+            return
+        seen.add(id(n))
+        if isinstance(n, P.Pipeline):
+            pipes.append(n)
+        if isinstance(n, P.Aggregate):
+            aggs.append(n)
+        for c in n.children():
+            walk(c, seen)
+
+    walk(r.plan, set())
+    assert aggs, "aggregate missing from the plan"
+    assert all(p.agg is None for p in pipes), (
+        "agg tail fused despite a Pallas mode"
+    )
+    assert pipes, "chain fusion lost under a Pallas mode"
+    a = r.collect().to_pylist()
+    b = off.sql(q).collect().to_pylist()
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        assert x["k"] == y["k"]
+        assert x["sf"] == pytest.approx(y["sf"], rel=1e-9)

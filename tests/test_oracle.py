@@ -345,6 +345,12 @@ def _template_sql(qnum):
 # integer columns, so the two dialects legitimately disagree:
 _INT_DIVISION_TEMPLATES = {34, 78, 83}
 
+# templates whose sqlite plans are un-indexed nested loops over the 1.9M-row
+# demographics tables (q13-class OR-joins): they hit the 60s abort deadline
+# on every run, so skip upfront instead of burning 2x60s per suite run to
+# rediscover it. The deadline below still guards any template not listed.
+_SQLITE_NESTED_LOOP_TEMPLATES = {13, 48}
+
 
 def _sqlite_compatible():
     """(template, part_index) pairs runnable on sqlite. Two-part templates
@@ -376,6 +382,11 @@ def test_template_matches_sqlite(all_engines, qnum, part):
     import datetime
     import time as _time
 
+    if qnum in _SQLITE_NESTED_LOOP_TEMPLATES:
+        pytest.skip(
+            f"sqlite nested-loop plan for query{qnum} exceeds the 60s "
+            f"deadline on every run (see _SQLITE_NESTED_LOOP_TEMPLATES)"
+        )
     sess, conn = all_engines
     whole = _template_sql(qnum)
     parts = [p for p in whole.split(";") if "select" in p.lower()]

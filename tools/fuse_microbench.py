@@ -1,15 +1,25 @@
-"""CI microbench guard: fused-pipeline executable reuse across a stream.
+"""CI microbench guard: fused-pipeline executable reuse across a stream,
+plus a measured dispatch-count reduction from aggregate-tail fusion.
 
-Runs a small synthetic query stream TWICE in one session — first pass
-untraced (it compiles the executables), second pass traced — then gates on
-the profiler's executable-cache hit rate over the traced pass:
+Part 1 runs a small synthetic query stream (Filter/Project chains AND
+agg-chain shapes) TWICE in one session — first pass untraced (it compiles
+the executables), second pass traced — then gates on the profiler's
+executable-cache hit rate over the traced pass:
 
     python tools/fuse_microbench.py        # exits nonzero below 80%
 
 A steady-state re-run of a stream must reuse the compiled pipelines (the
 whole point of shape-bucketed executable reuse); a refactor that silently
 changes pipeline fingerprints, input signatures, or the cache keying drops
-the rate to ~0 and fails this gate. Wired into ci/tier1-check.
+the rate to ~0 and fails this gate.
+
+Part 2 measures steady-state device-dispatch counts (kernel_span events +
+fused pipeline calls under NDS_TRACE_KERNELS-style tracing) for the plan
+shapes of the bench's tail queries — the multi-key grouped sum/avg chain
+(q4/q14's year_total), the global filtered aggregate (q9's bucket
+probes), and the join-fed grouped sum (q78) — eager vs fused, and
+requires the fused path to dispatch strictly fewer times on every shape.
+Both are wired into ci/tier1-check.
 """
 
 import os
@@ -43,12 +53,39 @@ STREAM = [
     "limit 20",
     "select k, case when v > 0 then v else -v end a from t "
     "where cat in ('Books', 'Shoes') order by k, a limit 50",
+    # agg-chain shapes: the aggregate tail must compile INTO the pipeline
+    # and its executable must be reused on the second pass
+    "select k, k2, sum(v) s, count(*) c from t where v > -60 "
+    "group by k, k2 order by k, k2",
+    "select count(*) c, avg(v) a, sum(v) s from t where v between 0 and 40",
 ]
+
+# steady-state dispatch A/B: synthetic stand-ins for the tail queries'
+# plan shapes (same operator chains, toy data) — eager must dispatch more
+TAIL_SHAPES = {
+    # q4/q14 year_total: filter + computed projection feeding a multi-key
+    # grouped sum/avg
+    "q4_year_total": (
+        "select k, k2, sum(v) s, avg(v) a, count(*) c from t "
+        "where v > -50 and k is not null group by k, k2 order by k, k2"
+    ),
+    # q9: ranged global aggregates over the fact scan
+    "q9_global": (
+        "select count(*) c, avg(v) a, sum(v) s from t "
+        "where v between 0 and 40"
+    ),
+    # q78: join output feeding a grouped sum
+    "q78_join_group": (
+        "select t.k, sum(t.v) sv, sum(u.v) uv from t, u "
+        "where t.k = u.k group by t.k order by t.k"
+    ),
+}
 
 
 def _table(n, seed):
     r = np.random.default_rng(seed)
     ks = r.integers(0, 12, n)
+    k2s = r.integers(0, 6, n)
     vs = r.integers(-90, 90, n)
     return pa.table(
         {
@@ -56,6 +93,7 @@ def _table(n, seed):
                 [None if i % 9 == 0 else int(x) for i, x in enumerate(ks)],
                 pa.int32(),
             ),
+            "k2": pa.array(k2s, pa.int32()),
             "v": pa.array(vs, pa.int64()),
             "cat": pa.array(
                 [["Books", "Music", "Shoes"][int(x) % 3] for x in ks],
@@ -63,6 +101,63 @@ def _table(n, seed):
             ),
         }
     )
+
+
+def _steady_dispatches(query, fuse_conf, trace_dir):
+    """Counted device dispatches of one steady-state execution: kernel
+    entry points (kernel_span, synchronized) + fused pipeline calls. An
+    undercount of the eager path (per-stage elementwise ops are not kernel
+    entry points) — which only makes the fused<eager assertion stricter."""
+    from nds_tpu.engine.session import Session
+    from nds_tpu.obs import reader as R
+    from nds_tpu.obs import trace as obs_trace
+
+    sess = Session(conf=dict(fuse_conf, **{
+        "engine.plan_cache": "off",
+        "engine.trace_dir": trace_dir,
+        "engine.trace_kernels": "on",
+    }))
+    sess.register_arrow("t", _table(3000, 1))
+    sess.register_arrow("u", _table(3000, 2))
+    warm_tracer, sess.tracer = sess.tracer, None
+    sess.sql(query).collect()  # cold: compiles; dispatches untraced
+    sess.tracer = warm_tracer
+    with obs_trace.bind(sess.tracer):
+        sess.sql(query).collect()  # steady: every dispatch traced
+    sess.tracer.close()
+    events = R.read_events([trace_dir], strict=True)
+    n = 0
+    for ev in events:
+        if ev.get("kind") == "kernel_span":
+            n += 1
+        elif ev.get("kind") == "pipeline_span" and ev.get("fused"):
+            n += 1
+    return n
+
+
+def dispatch_ab():
+    """Eager-vs-fused steady dispatch counts per tail shape; fails unless
+    the fused path dispatches strictly fewer times on EVERY shape."""
+    import tempfile
+
+    failures = []
+    for name, q in TAIL_SHAPES.items():
+        with tempfile.TemporaryDirectory(prefix="nds_mb_e_") as de, \
+                tempfile.TemporaryDirectory(prefix="nds_mb_f_") as df:
+            eager = _steady_dispatches(q, {"engine.fuse": "off"}, de)
+            fused = _steady_dispatches(q, {}, df)
+        verdict = "OK" if fused < eager else "NO REDUCTION"
+        print(f"fuse_microbench: {name}: eager {eager} -> fused {fused} "
+              f"dispatches ({verdict})")
+        if fused >= eager:
+            failures.append(name)
+    if failures:
+        print(
+            f"fuse_microbench: FAILED (no steady dispatch reduction on: "
+            f"{', '.join(failures)})",
+            file=sys.stderr,
+        )
+        sys.exit(1)
 
 
 def main():
@@ -102,7 +197,8 @@ def main():
                     f"fuse_microbench: FAILED (profiler gate exit {code})",
                     file=sys.stderr,
                 )
-            sys.exit(code)
+                sys.exit(code)
+    dispatch_ab()
     print("fuse_microbench: OK")
 
 
