@@ -1,19 +1,28 @@
-"""Static analysis: plan-IR verification + engine lint.
+"""Static analysis: plan-IR verification, plan budgeting + engine lint.
 
-Two complementary gates over the engine's correctness surface:
+Three complementary gates over the engine's correctness surface:
 
 * `verifier` — a PlanVerifier that re-checks structural invariants of the
   logical plan after binding and after each rewrite pass (schema
   resolvability with stable dtypes, Pipeline chain shape, blocked-union
-  annotation soundness, join-key scoping, LEFT->INNER promotion evidence),
-  the engine's counterpart of Catalyst's re-run analyzer. Gated by conf
-  `engine.verify_plans` / env NDS_VERIFY_PLANS (off | final | all).
+  annotation soundness, join-key scoping, LEFT->INNER promotion evidence,
+  physical-annotation placement, and — with a mesh — the sharding
+  invariant family), the engine's counterpart of Catalyst's re-run
+  analyzer. Gated by conf `engine.verify_plans` / env NDS_VERIFY_PLANS
+  (off | final | all).
+* `budget` — a static cost/memory analyzer that derives per-node
+  cardinality bounds and a peak-HBM byte model mirroring the executor's
+  materialization, and folds them into a load-bearing plan-time verdict:
+  direct | blocked(window_rows) | over | reject(admission). Gated by conf
+  `engine.plan_budget` / env NDS_PLAN_BUDGET (off | warn | on).
 * `lint` — an AST lint over nds_tpu/ codifying the repo's historical bug
   classes as rules (cross-stream module globals, epoch durations, torn
   report writes, host syncs in traced regions, hot-path imports, trace
-  event schema drift).
+  event schema drift, undocumented/unread conf knobs, unguarded session-
+  cache mutations).
 
-Both run in CI (ci/tier1-check): `tools/plan_verify_corpus.py` statically
-checks ALL 99 TPC-DS query templates through the verifier, and the lint
-must be clean over the package.
+All run in CI (ci/tier1-check): `tools/plan_verify_corpus.py` statically
+checks ALL 99 TPC-DS query templates through the verifier AND calibrates
+the budgeter at the SF1/SF10 catalogs, and the lint must be clean over
+the package.
 """

@@ -38,6 +38,17 @@ Every rule below encodes a bug this codebase actually shipped (and fixed):
                           entry can't be interpreted. Scope: everywhere
                           (skipped when no README is present, e.g. an
                           installed package without the repo).
+  unread-conf-knob        the inverse (tree-wide, run_unread_knob_lint):
+                          every documented `engine.*` key must be
+                          mentioned somewhere in code, so dead knob rows
+                          can't accumulate in the docs. Same README-on-
+                          disk skip as above.
+  cache-lock-discipline   the serve work (ROADMAP item 4) makes the
+                          session caches (exec_cache, join_order_cache,
+                          pallas_promotions, plan_cache) multi-tenant;
+                          every mutation outside a held session lock
+                          (`with session.cache_lock:`) is a latent race.
+                          Scope: everywhere.
 
 Pragma: append `# nds-lint: disable=<rule>[,<rule>...]` (with a
 justification!) on the offending line or the line directly above to
@@ -335,33 +346,37 @@ def _r_trace_event_schema(tree, relpath):
 _CONF_DOC_CACHE = None
 
 
-def documented_conf_keys():
+def documented_conf_keys(repo: str | None = None):
     """`engine.*` keys named in the repo's README (knob tables, prose) or
     any properties/ template — the set the code's reads must stay inside.
     None when the repo docs aren't present (installed package): the rule
-    then skips rather than flagging everything."""
+    then skips rather than flagging everything. The default (installed)
+    repo's key set is cached; an explicit `repo` re-reads (tests)."""
     global _CONF_DOC_CACHE
+    if repo is not None:
+        return _read_conf_doc_keys(repo)
     if _CONF_DOC_CACHE is None:
-        repo = os.path.dirname(package_root())
-        readme = os.path.join(repo, "README.md")
-        if not os.path.isfile(readme):
-            _CONF_DOC_CACHE = (None,)
-            return None
-        keys = set()
-        with open(readme, encoding="utf-8") as f:
-            keys.update(re.findall(r"engine\.[a-z0-9_]+", f.read()))
-        propdir = os.path.join(repo, "properties")
-        if os.path.isdir(propdir):
-            for name in os.listdir(propdir):
-                if not name.endswith(".properties"):
-                    continue
-                with open(os.path.join(propdir, name),
-                          encoding="utf-8") as f:
-                    keys.update(
-                        re.findall(r"engine\.[a-z0-9_]+", f.read())
-                    )
-        _CONF_DOC_CACHE = (keys,)
+        _CONF_DOC_CACHE = (
+            _read_conf_doc_keys(os.path.dirname(package_root())),
+        )
     return _CONF_DOC_CACHE[0]
+
+
+def _read_conf_doc_keys(repo: str):
+    readme = os.path.join(repo, "README.md")
+    if not os.path.isfile(readme):
+        return None
+    keys = set()
+    with open(readme, encoding="utf-8") as f:
+        keys.update(re.findall(r"engine\.[a-z0-9_]+", f.read()))
+    propdir = os.path.join(repo, "properties")
+    if os.path.isdir(propdir):
+        for name in os.listdir(propdir):
+            if not name.endswith(".properties"):
+                continue
+            with open(os.path.join(propdir, name), encoding="utf-8") as f:
+                keys.update(re.findall(r"engine\.[a-z0-9_]+", f.read()))
+    return keys
 
 
 def iter_conf_keys(tree):
@@ -402,6 +417,169 @@ def _r_undocumented_conf_knob(tree, relpath):
                 f"(with its default) or drop the dead knob"
             )))
     return out
+
+
+#: session-level caches whose mutation must hold the session cache lock
+#: (Session.cache_lock): the serve work (ROADMAP item 4) makes these
+#: multi-tenant, and every unguarded mutation is a latent race today
+_GUARDED_CACHES = (
+    "exec_cache", "join_order_cache", "pallas_promotions", "plan_cache",
+)
+
+#: attribute calls that mutate a cache object (ExecutableCache.lookup
+#: builds + inserts; OrderedDict/dict mutators). Plain `.get` reads are
+#: not flagged — the LRU caches' own get() sites are lock-wrapped anyway.
+_CACHE_MUTATORS = (
+    "clear", "put", "pop", "popitem", "update", "setdefault", "lookup",
+)
+
+
+def _chain_cache_name(expr):
+    """The guarded-cache attribute name reachable in an expression's
+    attribute chain (session.exec_cache.map -> "exec_cache"), or None."""
+    for x in ast.walk(expr):
+        if isinstance(x, ast.Attribute) and x.attr in _GUARDED_CACHES:
+            return x.attr
+    return None
+
+
+@_rule("cache-lock-discipline", _scope_all)
+def _r_cache_lock_discipline(tree, relpath):
+    # with-blocks whose context expression names a lock: everything inside
+    # their line span is considered guarded (the AST has no aliasing
+    # analysis; a lock held by a caller needs a justified pragma)
+    lock_spans = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                names = [
+                    x.attr for x in ast.walk(item.context_expr)
+                    if isinstance(x, ast.Attribute)
+                ] + [
+                    x.id for x in ast.walk(item.context_expr)
+                    if isinstance(x, ast.Name)
+                ]
+                if any(n.endswith("lock") for n in names):
+                    lock_spans.append((node.lineno, node.end_lineno))
+                    break
+
+    def guarded(line):
+        return any(a <= line <= b for a, b in lock_spans)
+
+    # local-alias taint: `cache = self._session_cache()` / `c = s.plan_cache`
+    tainted = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, (ast.Attribute, ast.Call)
+        ):
+            src = node.value
+            hit = _chain_cache_name(src) is not None or (
+                isinstance(src, ast.Call)
+                and isinstance(src.func, ast.Attribute)
+                and src.func.attr == "_session_cache"
+            )
+            if hit:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        tainted.add(t.id)
+
+    def receiver_is_cache(value):
+        if _chain_cache_name(value) is not None:
+            return True
+        return isinstance(value, ast.Name) and value.id in tainted
+
+    out = []
+    for node in ast.walk(tree):
+        line = msg = None
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _CACHE_MUTATORS
+            and receiver_is_cache(node.func.value)
+        ):
+            line = node.lineno
+            msg = f".{node.func.attr}() on a session cache"
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for t in targets:
+                if isinstance(t, ast.Subscript) and receiver_is_cache(t.value):
+                    line = node.lineno
+                    msg = "subscript store into a session cache"
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) and receiver_is_cache(t.value):
+                    line = node.lineno
+                    msg = "subscript delete from a session cache"
+        if line is not None and not guarded(line):
+            out.append((line, (
+                f"{msg} outside a held session lock "
+                f"(`with session.cache_lock:`); exec/join-order/pallas/"
+                f"plan caches go multi-tenant under the serve work and "
+                f"every unguarded mutation is a latent race"
+            )))
+    return out
+
+
+def run_unread_knob_lint(root: str | None = None,
+                         mentioned: set | None = None) -> list[Finding]:
+    """Inverse of `undocumented-conf-knob` (tree-wide, so not a per-file
+    rule): every `engine.*` key named in the README knob tables or a
+    properties/ template must be MENTIONED somewhere in the code (read,
+    written, or emitted) — dead knobs in the docs otherwise accumulate and
+    mis-teach operators. Findings point at README.md / the template.
+    `mentioned`: pre-collected engine.* mention set (run_lint passes the
+    one it gathered while reading the tree for the AST rules); None =
+    standalone invocation, read the tree here."""
+    root = root or package_root()
+    nested = os.path.join(root, "nds_tpu")
+    if os.path.basename(os.path.abspath(root)) != "nds_tpu" and os.path.isdir(
+        nested
+    ):
+        root = nested
+    documented = documented_conf_keys(os.path.dirname(os.path.abspath(root)))
+    if documented is None:
+        return []
+    if mentioned is None:
+        mentioned = set()
+        for path in iter_py_files(root):
+            with open(path, encoding="utf-8") as f:
+                mentioned.update(
+                    re.findall(r"engine\.[a-z0-9_]+", f.read())
+                )
+    dead = sorted(documented - mentioned)
+    if not dead:
+        return []
+    repo = os.path.dirname(root)
+    findings = []
+    sources = [("README.md", os.path.join(repo, "README.md"))]
+    propdir = os.path.join(repo, "properties")
+    if os.path.isdir(propdir):
+        sources += [
+            (f"properties/{n}", os.path.join(propdir, n))
+            for n in sorted(os.listdir(propdir))
+            if n.endswith(".properties")
+        ]
+    for key in dead:
+        for rel, path in sources:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    lines = f.read().splitlines()
+            except OSError:
+                continue
+            for i, line in enumerate(lines, start=1):
+                if key in line:
+                    findings.append(Finding(rel, i, "unread-conf-knob", (
+                        f"conf knob {key!r} is documented here but no code "
+                        f"reads it — drop the dead knob row or wire the "
+                        f"knob back up"
+                    )))
+                    break
+            else:
+                continue
+            break
+    return findings
 
 
 def iter_emit_calls(tree):
@@ -485,11 +663,17 @@ def run_lint(root: str | None = None) -> list[Finding]:
     ):
         root = nested
     findings = []
+    mentioned = set()
     for path in iter_py_files(root):
         rel = os.path.relpath(path, root).replace(os.sep, "/")
         with open(path, encoding="utf-8") as f:
             src = f.read()
+        mentioned.update(re.findall(r"engine\.[a-z0-9_]+", src))
         findings.extend(lint_source(src, rel))
+    # tree-wide inverse knob pass (documented-but-unread keys): per-file
+    # rules cannot see the whole read set, so it runs once here, reusing
+    # the mention set gathered above instead of re-reading the tree
+    findings.extend(run_unread_knob_lint(root, mentioned=mentioned))
     return findings
 
 

@@ -28,7 +28,15 @@ expressions included) and checks:
   null-rejecting conjunct shape (`binder._null_rejecting_shape`);
 * ORDER BY .. LIMIT top-k nodes preserve the sort-key schema (every sort
   key resolves over the Sort input, which the top-k gather reads);
-* SetOp sides agree on arity and aligned output names.
+* SetOp sides agree on arity and aligned output names;
+* physical-choice annotations sit only where their consumer reads them
+  (`_topk_safe` on Sorts, `donate_ok` on Pipelines, `budget_window_rows`
+  on blocked-union Aggregates — the physical-annotation family);
+* with a mesh: the sharding invariant family (PartitionSpec axis
+  consistency across node boundaries, all_to_all exchange arity,
+  replicated-dim legality) against the canonical layout registry
+  (`table_partition_spec`), registered ahead of the mesh rewrite pass
+  per the PR-5 contract (ROADMAP item 1).
 
 Gating: conf `engine.verify_plans` / env `NDS_VERIFY_PLANS` = off (default)
 | final (verify the finished plan once) | all (verify after binding and
@@ -52,6 +60,33 @@ from ..engine import expr as E
 from ..engine import plan as P
 from ..engine.binder import _null_rejecting_shape
 from ..engine.expr import _lit_dtype, _promote
+from ..schema import TABLE_PARTITIONING
+from .budget import bucket_cap as _bucket_cap, schema_row_bytes
+
+# ---------------------------------------------------------------------------
+# PartitionSpec layout registry (ROADMAP item 1: sharding invariants are
+# registered here BEFORE the mesh rewrite pass lands — the PR-5 contract).
+# The engine's canonical layout (session.Catalog._to_device): fact tables
+# row-shard over the mesh's `data` axis, everything else replicates.
+# ---------------------------------------------------------------------------
+
+#: the canonical row-sharding mesh axis (parallel/dist.py builds meshes
+#: with this axis; PartitionSpec("data") shards rows across it)
+PARTITION_AXIS = "data"
+
+#: a replicated relation above this many device bytes is a layout bug — a
+#: fact-scale table copied to every chip defeats sharding entirely (the
+#: replicated-dim legality rule)
+REPLICATED_BYTES_CAP = 2 << 30
+
+
+def table_partition_spec(table: str) -> tuple:
+    """The canonical PartitionSpec axes for a base table: ("data",) row
+    sharding for the partitioned fact tables, () (replicated) for
+    dimensions — derived from the same TABLE_PARTITIONING registry
+    Catalog._to_device places from, so the verifier's sharding rules and
+    the actual device layout cannot disagree."""
+    return (PARTITION_AXIS,) if table in TABLE_PARTITIONING else ()
 
 
 class PlanVerifyError(Exception):
@@ -141,11 +176,162 @@ class PlanVerifier:
         self._refs: dict[int, int] = {}
 
     # ------------------------------------------------------------------
-    def verify(self, root: P.PlanNode, promotions=()) -> list[str]:
+    def verify(self, root: P.PlanNode, promotions=(), mesh=None) -> list[str]:
         self._refs = _count_plan_refs(root)
         self._schema_of(root)
         self._check_promotions(promotions)
+        self._check_annotations(root)
+        if mesh is not None:
+            self._check_sharding(root, mesh)
         return list(self.violations)
+
+    # ------------------------------------------------------------------
+    # physical-annotation coverage: dynamic annotations (`_topk_safe`,
+    # `donate_ok`, `budget_window_rows`) are load-bearing across passes —
+    # one landing on the wrong node class silently changes execution, so
+    # placement itself is verified, not just the annotated nodes' shape
+    # ------------------------------------------------------------------
+    def _check_annotations(self, root: P.PlanNode):
+        nodes = [v for v in P.walk_plan(root) if isinstance(v, P.PlanNode)]
+        for n in nodes:
+            if getattr(n, "_topk_safe", False) and not isinstance(n, P.Sort):
+                # fuse annotates every single-consumer Sort (the Limit
+                # executor is the only reader); the annotation on any
+                # other node class means a rewrite copied it somewhere a
+                # future top-k check could mis-trust
+                self._viol(
+                    "physical-annotation", n,
+                    "_topk_safe set on a non-Sort node (only ORDER BY "
+                    "sorts own the top-k single-consumer contract)",
+                )
+            if getattr(n, "donate_ok", False) and not isinstance(
+                n, P.Pipeline
+            ):
+                self._viol(
+                    "physical-annotation", n,
+                    "donate_ok set on a non-Pipeline node (only fused "
+                    "pipelines own the donation contract)",
+                )
+            if getattr(n, "budget_window_rows", None) is not None:
+                if not (
+                    isinstance(n, P.Aggregate) and n.blocked_union
+                ):
+                    self._viol(
+                        "physical-annotation", n,
+                        "budget_window_rows set on a node that is not a "
+                        "blocked-union Aggregate (the windowed executor "
+                        "is the only consumer of static window sizing)",
+                    )
+
+    # ------------------------------------------------------------------
+    # sharding invariants (registered ahead of the mesh rewrite pass —
+    # ROADMAP item 1 / the PR-5 contract): PartitionSpec axis consistency
+    # across node boundaries, exchange arity, replicated-dim legality
+    # ------------------------------------------------------------------
+    def _check_sharding(self, root: P.PlanNode, mesh):
+        try:
+            n_dev = int(mesh.devices.size)
+        except AttributeError:
+            n_dev = int(getattr(mesh, "size", 0)) or 1
+        if n_dev & (n_dev - 1):
+            self._viol(
+                "exchange-arity", None,
+                f"mesh has {n_dev} devices: capacity buckets are powers "
+                f"of two, so row-sharded caps and all_to_all exchange "
+                f"routing (cap % n_dev == 0) can never align on a "
+                f"non-power-of-two mesh",
+            )
+        specs: dict[int, tuple] = {}
+
+        def spec_of(n) -> tuple:
+            if n is None:
+                return ()
+            key = id(n)
+            if key in specs:
+                return specs[key]
+            specs[key] = s = _spec(n)
+            return s
+
+        def _spec(n) -> tuple:
+            if isinstance(n, P.Scan):
+                s = table_partition_spec(n.table)
+                rows = self._table_rows(n.table)
+                if s and rows is not None and n_dev > 0:
+                    cap = _bucket_cap(rows)
+                    if cap % n_dev:
+                        self._viol(
+                            "replicated-dim", n,
+                            f"fact table {n.table!r} (cap {cap}) is not "
+                            f"divisible by the {n_dev}-device mesh; the "
+                            f"catalog would silently replicate it instead "
+                            f"of row-sharding",
+                        )
+                if not s and rows is not None:
+                    width = self._scan_width(n)
+                    if rows * width > REPLICATED_BYTES_CAP:
+                        self._viol(
+                            "replicated-dim", n,
+                            f"replicated relation {n.table!r} is "
+                            f"~{rows * width >> 20} MiB per device; "
+                            f"replicating past "
+                            f"{REPLICATED_BYTES_CAP >> 30} GiB defeats "
+                            f"sharding (partition it or shrink it)",
+                        )
+                return s
+            if isinstance(n, (P.Aggregate, P.Distinct)):
+                spec_of(n.child)
+                return ()  # partial results merge (psum): output replicated
+            if isinstance(n, P.SetOp):
+                ls, rs = spec_of(n.left), spec_of(n.right)
+                if ls != rs:
+                    self._viol(
+                        "sharding-axis", n,
+                        f"{n.op} sides carry different partition specs "
+                        f"({ls or 'replicated'} vs {rs or 'replicated'}): "
+                        f"a concat across mixed layouts mixes per-device "
+                        f"row subsets with full copies",
+                    )
+                return ls
+            if isinstance(n, (P.Join, P.MultiJoin)):
+                child_specs = [spec_of(c) for c in n.children() if c is not None]
+                sharded = [s for s in child_specs if s]
+                axes = {s for s in sharded}
+                if len(axes) > 1:
+                    self._viol(
+                        "sharding-axis", n,
+                        f"join inputs are sharded over different axes "
+                        f"{sorted(axes)}; an exchange can only route "
+                        f"within one axis",
+                    )
+                return sharded[0] if sharded else ()
+            out = ()
+            for c in n.children():
+                if c is not None:
+                    s = spec_of(c)
+                    if s:
+                        out = s
+            return out
+
+        for v in P.walk_plan(root):
+            if isinstance(v, P.PlanNode):
+                spec_of(v)
+
+    def _table_rows(self, table: str):
+        if self.catalog is None:
+            return None
+        e = getattr(self.catalog, "entries", {}).get(table)
+        if e is None:
+            return None
+        if getattr(e, "nrows", None) is not None:
+            return int(e.nrows)
+        arrow = getattr(e, "arrow", None)
+        if arrow is not None:
+            return int(arrow.num_rows)
+        return None
+
+    def _scan_width(self, node: P.Scan) -> int:
+        sch = self._schema_of(node)
+        return schema_row_bytes(sch) if sch else 9
 
     def _viol(self, rule: str, node, msg: str):
         where = f" [{type(node).__name__}]" if node is not None else ""
@@ -930,11 +1116,13 @@ def verify_plan(
     stage: str = "final",
     promotions=(),
     tracer=None,
+    mesh=None,
 ) -> None:
     """Run the PlanVerifier; emit a `plan_verify` trace event; raise
     PlanVerifyError (classified `planner` by faults.classify) on any
-    violation."""
-    violations = PlanVerifier(catalog).verify(plan, promotions)
+    violation. With a `mesh`, the sharding invariant family (axis
+    consistency, exchange arity, replicated-dim legality) runs too."""
+    violations = PlanVerifier(catalog).verify(plan, promotions, mesh=mesh)
     if tracer is not None:
         ev = {"stage": stage, "ok": not violations}
         if violations:

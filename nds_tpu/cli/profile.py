@@ -96,6 +96,16 @@ def _render_profile(prof, top: int, per_query: bool):
           f"watchdog fires {t['watchdog_fires']}; faults injected "
           f"{t['faults_injected']}; blocked-union windows "
           f"{t['blocked_union_windows']}")
+    pb = prof.get("plan_budget") or {}
+    if pb.get("verdicts"):
+        verdicts = ", ".join(
+            f"{v} x{n}" for v, n in sorted(pb["verdicts"].items())
+        )
+        wm = t.get("mem_watermarks", 0)
+        print(f"== plan budget: {verdicts}; max modeled peak "
+              f"{_fmt_bytes(pb['max_peak_bytes'])} vs budget "
+              f"{_fmt_bytes(pb['max_budget_bytes'])}"
+              + (f"; host watermarks {wm}" if wm else ""))
     rate = R.exec_cache_hit_rate(prof)
     if rate is not None or t["pipelines_fused"] or t["pipelines_eager"]:
         rate_s = "-" if rate is None else f"{rate:.1%}"

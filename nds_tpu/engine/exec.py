@@ -258,7 +258,8 @@ class Executor:
         )
         cache = self._session_cache() if cacheable else None
         if cache is not None:
-            hit = cache.get(self._fp(node))
+            with self.catalog.session.cache_lock:
+                hit = cache.get(self._fp(node))
             if tracer is not None:
                 tracer.emit(
                     "plan_cache", node=type(node).__name__,
@@ -300,7 +301,8 @@ class Executor:
             out = m(node)
         self._cte_cache[key] = out
         if cache is not None:
-            cache.put(self._fp(node), out)
+            with self.catalog.session.cache_lock:
+                cache.put(self._fp(node), out)
         return out
 
     def to_arrow(self, node: P.PlanNode) -> pa.Table:
@@ -405,9 +407,10 @@ class Executor:
             else:
                 def build():
                     return fuse.FusedPipeline(node.stages, child)
-            entry, hit = session.exec_cache.lookup(
-                fp, sig, child.cap, build
-            )
+            with session.cache_lock:
+                entry, hit = session.exec_cache.lookup(
+                    fp, sig, child.cap, build
+                )
             if tracer is not None:
                 tracer.emit(
                     "exec_cache", pipeline=fp[:12], bucket=child.cap,
@@ -431,7 +434,8 @@ class Executor:
                         raise
                     # compile/runtime failure on a chain that traced
                     # abstractly: pin the signature to the eager path
-                    session.exec_cache.map[(fp, sig)] = None
+                    with session.cache_lock:
+                        session.exec_cache.map[(fp, sig)] = None
                     self.on_task_failure(
                         f"pipeline fuse fallback: {str(exc)[:120]}"
                     )
@@ -804,7 +808,10 @@ class Executor:
             session is not None
             and session.conf.get("engine.join_order_cache", "on") != "off"
         ):
-            trace = session.join_order_cache.setdefault(self._fp(node), {})
+            with session.cache_lock:
+                trace = session.join_order_cache.setdefault(
+                    self._fp(node), {}
+                )
         return self._multijoin_over_tables(tables, node.edges, trace=trace)
 
     def _multijoin_over_tables(self, tables, edges, trace=None) -> Table:
@@ -905,7 +912,11 @@ class Executor:
             merged[gj] = gi
             current[gi] = joined
         if trace is not None and not replay:
-            trace["steps"] = steps
+            # `trace` may be a join_order_cache entry (steady replays read
+            # it from other statements' threads) or a blocked-union
+            # context's private memo; both callers guarantee a session
+            with self.catalog.session.cache_lock:
+                trace["steps"] = steps
         return current[group(0)]
 
     # ------------------------------------------------------------------
@@ -1572,7 +1583,13 @@ class Executor:
             ),
             1,
         )
-        wrows = session.union_agg_window_rows(row_bytes)
+        # the plan budgeter's statically chosen window (budget_window_rows
+        # annotation) wins over the runtime derivation; explicit conf/env
+        # still win over both (session.union_agg_window_rows)
+        wrows = session.union_agg_window_rows(
+            row_bytes,
+            static_rows=getattr(node, "budget_window_rows", None),
+        )
         if total_rows <= wrows:
             # single window: the unblocked path is equivalent. Cheap bail —
             # the branch tables just executed are id-cached in _cte_cache,
@@ -1679,9 +1696,10 @@ class Executor:
         if fp is None:
             return self._apply_wrappers(t, wrappers)
         sig = fuse.input_signature(t)
-        entry, hit = session.exec_cache.lookup(
-            fp, sig, t.cap, lambda: fuse.FusedPipeline(stages, t)
-        )
+        with session.cache_lock:
+            entry, hit = session.exec_cache.lookup(
+                fp, sig, t.cap, lambda: fuse.FusedPipeline(stages, t)
+            )
         if self.tracer is not None:
             self.tracer.emit(
                 "exec_cache", pipeline=fp[:12], bucket=t.cap, hit=hit,
@@ -1692,7 +1710,8 @@ class Executor:
         try:
             return entry.call(t, False)  # windows alias branch buffers
         except Exception as exc:
-            session.exec_cache.map[(fp, sig)] = None
+            with session.cache_lock:
+                session.exec_cache.map[(fp, sig)] = None
             self.on_task_failure(
                 f"window fuse fallback: {str(exc)[:120]}"
             )
@@ -1703,13 +1722,32 @@ class Executor:
         plain shape) over the union input, evaluated window by window with
         incremental partial merging. Returns the same table an unblocked
         _aggregate_once would (hidden avg sum/count columns included)."""
-        wcap = ctx["window_cap"]
         key_merge = [(E.Col(name), name) for _, name in node.keys]
         merged = None
         empty_partial = None
+        session = getattr(self.catalog, "session", None)
         for b, aligner in zip(ctx["branches"], ctx["aligners"]):
-            for start in range(0, b.nrows, wcap):
+            start = 0
+            while start < b.nrows:
+                wcap = ctx["window_cap"]
+                if (
+                    session is not None
+                    and getattr(session, "_mem_pressure", False)
+                    and wcap > 4096
+                ):
+                    # host-RSS watermark pre-emption (report.py via
+                    # obs.memwatch): shrink the REMAINING windows before
+                    # the allocator fails. Halving a power-of-two cap
+                    # keeps `start` aligned (start is a multiple of every
+                    # previous cap, all powers of two >= the new one).
+                    session._mem_pressure = False
+                    wcap = ctx["window_cap"] = max(wcap // 2, 4096)
+                    self.on_task_failure(
+                        f"host memory watermark: blocked-union window "
+                        f"shrunk to {wcap} rows mid-query"
+                    )
                 w = window_slice(b, start, wcap)
+                start += wcap
                 ctx["windows"] += 1
                 ctx["max_table_cap"] = max(ctx["max_table_cap"], w.cap)
                 # branch-to-union alignment (rename/cast/dictionary remap)
@@ -2264,13 +2302,14 @@ class Executor:
             pallas_ms = timed(run_pallas)
         except Exception:
             pallas_ms = float("inf")  # no Pallas lowering: never promote
-        rec = session.pallas_promotions[key] = {
-            "jnp_ms": round(jnp_ms, 3),
-            "pallas_ms": (
-                round(pallas_ms, 3) if pallas_ms != float("inf") else None
-            ),
-            "use": pallas_ms < jnp_ms,
-        }
+        with session.cache_lock:
+            rec = session.pallas_promotions[key] = {
+                "jnp_ms": round(jnp_ms, 3),
+                "pallas_ms": (
+                    round(pallas_ms, 3) if pallas_ms != float("inf") else None
+                ),
+                "use": pallas_ms < jnp_ms,
+            }
         if self.tracer is not None:
             self.tracer.emit(
                 "kernel_span", kernel=f"{kname}:jnp",

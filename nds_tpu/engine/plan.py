@@ -240,6 +240,29 @@ def fingerprint(node: PlanNode) -> str:
     return hashlib.sha256("\x00".join(out).encode()).hexdigest()
 
 
+def walk_plan(root):
+    """Yield every PlanNode and Expr reachable from `root` exactly once
+    (id-deduplicated; subquery plans riding inside expressions included),
+    via generic dataclass-field recursion — the one traversal shared by
+    the annotation/analysis passes so a plan-IR field change lands in one
+    place."""
+    import dataclasses
+
+    seen = set()
+    stack = [root]
+    while stack:
+        v = stack.pop()
+        if isinstance(v, (PlanNode, E.Expr)):
+            if id(v) in seen:
+                continue
+            seen.add(id(v))
+            yield v
+            for f in dataclasses.fields(v):
+                stack.append(getattr(v, f.name))
+        elif isinstance(v, (list, tuple)):
+            stack.extend(v)
+
+
 def _peel_wrappers(n):
     """(Project/Filter wrapper list top-down, first non-wrapper node).
 

@@ -10,6 +10,7 @@ SQL, and returns Arrow tables.
 from __future__ import annotations
 
 import os
+import threading
 from time import perf_counter as _perf
 from typing import Optional
 
@@ -510,41 +511,59 @@ class Session:
         # the winning route (exec._pallas_promoted). Session-lived: the
         # measurement is backend-stable, so one A/B covers every re-run.
         self.pallas_promotions = {}
+        # one lock guards every session-level cache mutation (plan_cache,
+        # exec_cache, join_order_cache, pallas_promotions): the serve work
+        # (ROADMAP item 4) makes these multi-tenant, and the
+        # cache-lock-discipline lint flags unguarded mutations. RLock: the
+        # recovery path clears caches from inside already-locked regions.
+        self.cache_lock = threading.RLock()
+        # static plan-budget verdict of the most recent statement
+        # (analysis/budget.py budget_plan); the report ladder's first
+        # device-OOM rung consumes the window recommendation
+        self.last_plan_budget = None
+        # host-RSS watermark pre-emption flag (obs.memwatch -> report.py):
+        # the blocked-union window loop polls it between windows and
+        # shrinks the remaining windows when set
+        self._mem_pressure = False
+        # watermark hysteresis latch (report.py): True while the process
+        # RSS excursion that last fired the watermark is still above it,
+        # so one crossing shrinks the window once, not once per query
+        self._rss_above_watermark = False
 
     def _catalog_changed(self):
         """Any registration/drop/invalidation: cached plan results may now
         be stale — drop them all."""
-        self.plan_cache.clear()
-        # join orders are only a perf heuristic, but sizes may have shifted
-        # enough to make a recorded order pathological — re-derive
-        self.join_order_cache.clear()
+        with self.cache_lock:
+            self.plan_cache.clear()
+            # join orders are only a perf heuristic, but sizes may have
+            # shifted enough to make a recorded order pathological
+            self.join_order_cache.clear()
 
-    # blocked union-aggregation windows get this fraction of the catalog's
-    # device budget (the window buffers coexist with cached base tables and
-    # the per-window partial-aggregation intermediates)
-    _UNION_AGG_WINDOW_BUDGET_FRACTION = 16
-
-    def union_agg_window_rows(self, row_bytes: int) -> int:
+    def union_agg_window_rows(
+        self, row_bytes: int, static_rows: Optional[int] = None
+    ) -> int:
         """Rows per window for blocked union-aggregation (engine/exec.py).
 
         Resolution order: `engine.union_agg_window_rows` session conf, then
-        the NDS_UNION_AGG_WINDOW_ROWS env knob (both honored exactly — tests
-        force tiny windows through them), else derived from the per-session
-        HBM budget the catalog already tracks: a window of `row_bytes`-wide
-        rows gets ~1/16 of DEVICE_BUDGET_BYTES, rounded down to a power of
-        two so slice shapes stay stable, clamped to [64Ki, 16Mi] rows."""
+        the NDS_UNION_AGG_WINDOW_ROWS env knob (both honored exactly —
+        tests force tiny windows through them), then `static_rows` (the
+        plan budgeter's statically chosen `budget_window_rows` annotation,
+        analysis/budget.py), else derived at runtime by the same formula
+        the budgeter uses (budget.default_window_rows) against the
+        catalog's device budget — plan-time and runtime sizing share one
+        derivation so they cannot drift."""
         v = self.conf.get("engine.union_agg_window_rows") or os.environ.get(
             "NDS_UNION_AGG_WINDOW_ROWS"
         )
         if v:
             return max(int(v), 1)
-        budget = (
-            self.catalog.DEVICE_BUDGET_BYTES
-            // self._UNION_AGG_WINDOW_BUDGET_FRACTION
+        if static_rows:
+            return max(int(static_rows), 1)
+        from ..analysis import budget as _budget
+
+        return _budget.default_window_rows(
+            row_bytes, self.catalog.DEVICE_BUDGET_BYTES
         )
-        rows = max(budget // max(row_bytes, 1), 1)
-        pow2 = 1 << (rows.bit_length() - 1)  # round DOWN: stay within budget
-        return int(min(max(pow2, 1 << 16), 1 << 24))
 
     # ---- registration ----------------------------------------------------
     def register_arrow(self, name, arrow: pa.Table, schema=None):
@@ -618,12 +637,13 @@ class Session:
         executor loss -> task retry on a fresh executor)."""
         import gc
 
-        self.plan_cache.clear()
-        # fused-pipeline executables bake dictionary lookup tables in as
-        # device constants; a full wipe must release those too (rebuilds
-        # are cheap next to an OOM'd retry failing again)
-        self.exec_cache.clear()
-        self.join_order_cache.clear()
+        with self.cache_lock:
+            self.plan_cache.clear()
+            # fused-pipeline executables bake dictionary lookup tables in
+            # as device constants; a full wipe must release those too
+            # (rebuilds are cheap next to an OOM'd retry failing again)
+            self.exec_cache.clear()
+            self.join_order_cache.clear()
         for e in self.catalog.entries.values():
             e.device_cols = {}
         gc.collect()
@@ -684,7 +704,7 @@ class Session:
             def verify(p, stage):
                 _verifier.verify_plan(
                     p, self.catalog, stage=stage, promotions=promotions,
-                    tracer=self.tracer,
+                    tracer=self.tracer, mesh=self.mesh,
                 )
 
         if verify is not None and level == "all":
@@ -710,6 +730,17 @@ class Session:
             )
             if verify is not None and level == "all":
                 verify(plan, "mark_pipelines")
+        # static plan budgeter (analysis/budget.py): modeled peak vs the
+        # working-set budget decides direct | blocked(window) | over |
+        # reject BEFORE anything dispatches; `blocked` annotates the
+        # statically sized window (exec consumes it), `reject` raises.
+        # Runs before the final verify so the verifier's annotation-
+        # coverage rule sees the budget_window_rows it just placed.
+        from ..analysis.budget import budget_plan
+
+        budget_plan(plan, self)
+        if verify is not None and level == "all":
+            verify(plan, "plan_budget")
         if verify is not None and level == "final":
             verify(plan, "final")
         return plan

@@ -88,9 +88,12 @@ _IO_PAT = (
     "503 Service",
 )
 # PlanVerifyError: the static plan verifier (analysis/verifier.py) found a
-# structural invariant violation — deterministic, so the ladder fails fast
+# structural invariant violation — deterministic, so the ladder fails fast.
+# PlanBudgetError: admission control (analysis/budget.py) refused the plan
+# statically — equally deterministic for a given catalog, same fail-fast.
 _PLANNER_PAT = (
     "ParseError", "BindError", "ExecError", "SyntaxError", "PlanVerifyError",
+    "PlanBudgetError",
 )
 _DATA_PAT = ("malformed", "LakehouseError", "schema mismatch", "Invalid value")
 

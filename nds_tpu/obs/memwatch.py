@@ -49,9 +49,19 @@ def rss_bytes():
 class MemorySampler:
     """Background high-water sampler: max over periodic samples of the best
     available memory signal. Use as a context manager; read `.peak_bytes`
-    (int | None) and `.source` ("device" | "rss" | None) after exit."""
+    (int | None) and `.source` ("device" | "rss" | None) after exit.
 
-    def __init__(self, interval_s: float | None = None):
+    Host-OOM pre-emption: with `watermark_bytes` set, the sampler fires
+    `on_watermark(sample_bytes)` ONCE from its thread the first time the
+    process RSS crosses the watermark — the ladder-before-the-allocator
+    hook report.py uses to shrink the blocked-union window mid-query
+    (ROADMAP carry-forward: pre-empt via RSS watermarks before the
+    allocator fails). The watermark always watches RSS, independent of
+    which signal feeds `peak_bytes`: host allocation death is a host-side
+    phenomenon even when device stats are the better high-water source."""
+
+    def __init__(self, interval_s: float | None = None,
+                 watermark_bytes: int | None = None, on_watermark=None):
         if interval_s is None:
             interval_s = (
                 float(os.environ.get("NDS_TRACE_MEM_INTERVAL_MS", "50")) / 1000
@@ -59,6 +69,9 @@ class MemorySampler:
         self.interval_s = max(interval_s, 0.001)
         self.peak_bytes = None
         self.source = None
+        self.watermark_bytes = watermark_bytes or None
+        self.on_watermark = on_watermark
+        self.watermark_fired = False
         self._stop = threading.Event()
         self._thread = None
         # probe once up front so source selection is stable for the run
@@ -73,6 +86,18 @@ class MemorySampler:
         v = self._read()
         if v is not None and (self.peak_bytes is None or v > self.peak_bytes):
             self.peak_bytes = v
+        if (
+            self.watermark_bytes
+            and not self.watermark_fired
+            and self.on_watermark is not None
+        ):
+            r = v if self.source == "rss" else rss_bytes()
+            if r is not None and r >= self.watermark_bytes:
+                self.watermark_fired = True
+                try:
+                    self.on_watermark(r)
+                except Exception:
+                    pass  # pre-emption must never take the query down
 
     def _loop(self):
         while not self._stop.wait(self.interval_s):

@@ -252,6 +252,12 @@ def profile_events(events) -> dict:
         "exec_cache_misses": 0,
         "pipelines_fused": 0,
         "pipelines_eager": 0,
+        "mem_watermarks": 0,
+    }
+    budget = {
+        "verdicts": {},  # verdict -> statement count
+        "max_peak_bytes": 0,
+        "max_budget_bytes": 0,
     }
     for ev in events:
         k = ev.get("kind")
@@ -302,11 +308,23 @@ def profile_events(events) -> dict:
             kt["count"] += 1
             kt["dur_ms"] += float(ev.get("dur_ms") or 0.0)
             kt["n_rows"] += int(ev.get("n") or 0)
+        elif k == "plan_budget":
+            v = ev.get("verdict") or "<unknown>"
+            budget["verdicts"][v] = budget["verdicts"].get(v, 0) + 1
+            budget["max_peak_bytes"] = max(
+                budget["max_peak_bytes"], int(ev.get("peak_bytes") or 0)
+            )
+            budget["max_budget_bytes"] = max(
+                budget["max_budget_bytes"], int(ev.get("budget_bytes") or 0)
+            )
+        elif k == "mem_watermark":
+            tallies["mem_watermarks"] += 1
     return {
         "queries": queries,
         "op_totals": op_totals,
         "kernel_totals": kernel_totals,
         "tallies": tallies,
+        "plan_budget": budget,
     }
 
 

@@ -82,6 +82,14 @@ EVENT_SCHEMA = {
     # the plan verifier checked a statement's plan at one rewrite stage
     # (engine.verify_plans; ok=False events also carry violations/first)
     "plan_verify": ("stage", "ok"),
+    # the static plan budgeter's per-statement verdict (engine.plan_budget;
+    # analysis/budget.py): modeled peak vs the working-set budget, plus
+    # peak_blocked_bytes/window_rows/nodes detail
+    "plan_budget": ("verdict", "peak_bytes", "budget_bytes"),
+    # the host-RSS watermark sampler pre-empted memory pressure mid-query
+    # (report.py; shrinks the blocked-union window before the allocator
+    # fails)
+    "mem_watermark": ("rss_bytes", "watermark_bytes"),
 }
 
 #: kinds kept in EVENT_SCHEMA for old-log readers but no longer emitted by

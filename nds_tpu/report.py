@@ -76,6 +76,22 @@ def engine_conf(session) -> dict:
     return {k: str(v) for k, v in conf.items()}
 
 
+def host_rss_watermark(session) -> int:
+    """Host-RSS pre-emption watermark in bytes; 0 disables (the default).
+    Conf `engine.host_rss_watermark` wins over NDS_HOST_RSS_WATERMARK.
+    When the process RSS crosses it mid-query, the sampler shrinks the
+    blocked-union window for the remaining windows / later queries and
+    records a `host_watermark_shrink` ladder entry — recovery BEFORE the
+    allocator fails, instead of after (ROADMAP carry-forward)."""
+    v = getattr(session, "conf", {}).get(
+        "engine.host_rss_watermark"
+    ) or os.environ.get("NDS_HOST_RSS_WATERMARK")
+    try:
+        return max(int(v), 0) if v else 0
+    except (TypeError, ValueError):
+        return 0
+
+
 def query_timeout(session) -> float:
     """Per-query watchdog budget in seconds; 0 disables (the default).
     Conf `engine.query_timeout` wins over the NDS_QUERY_TIMEOUT env knob."""
@@ -174,17 +190,72 @@ class BenchReport:
     # ------------------------------------------------------------------
     # degradation ladder
     # ------------------------------------------------------------------
+    def _budget_prediction(self):
+        """The static plan budgeter's record for the last planned
+        statement when its verdict predicted memory pressure
+        (analysis/budget.py sets Session.last_plan_budget), else None."""
+        rec = getattr(self.session, "last_plan_budget", None)
+        if not isinstance(rec, dict):
+            return None
+        if rec.get("verdict") not in ("blocked", "over", "reject"):
+            return None
+        return rec
+
+    def _explicit_window(self):
+        """The explicitly forced blocked-union window (conf wins over the
+        NDS_UNION_AGG_WINDOW_ROWS env knob — the same resolution order
+        Session.union_agg_window_rows uses), or None. Every shrink path
+        must derive from THIS, not the conf knob alone: writing conf
+        eclipses env, so ignoring an env-forced tiny window would let a
+        'shrink' grow the effective window."""
+        v = getattr(self.session, "conf", {}).get(
+            "engine.union_agg_window_rows"
+        ) or os.environ.get("NDS_UNION_AGG_WINDOW_ROWS")
+        try:
+            return int(v) if v else None
+        except (TypeError, ValueError):
+            return None
+
+    def _budget_recommendation(self):
+        """A window recommendation the budget_shrink rung can still APPLY:
+        the prediction must carry a window (seamless over-budget plans do
+        not — a knob the plan cannot consume would only waste a retry and
+        pollute later statements' static sizing) and must not already be
+        annotated into the plan (a blocked-verdict attempt ran the static
+        window and OOM'd anyway; re-applying the identical value is
+        recover_retry with extra steps). None otherwise — the ladder then
+        behaves exactly as before the budgeter existed."""
+        rec = self._budget_prediction()
+        if rec is None or rec.get("annotated"):
+            return None
+        return rec.get("window_rows") or None
+
     def _next_rung(self, kind: str, rungs_taken, can_retry: bool):
         """The next recovery rung for a failure of `kind`, or None.
 
-        device_oom: recover_memory+retry, then shrink the blocked-union
-        window (PR-1) and retry on a clean device; host_oom: recover+retry
-        once; io_transient: up to NDS_IO_RETRIES backoff retries; timeout/
-        planner/data/unknown: deterministic or likely-to-repeat — fail fast."""
+        device_oom: when the static budgeter predicted this plan over
+        budget, the FIRST rung applies its recommendation
+        (`budget_shrink`: recover + the statically derived window) instead
+        of a blind recover/halve cycle; then recover_memory+retry, then
+        shrink the blocked-union window (PR-1) and retry on a clean
+        device; host_oom: recover+retry once; io_transient: up to
+        NDS_IO_RETRIES backoff retries; timeout/planner/data/unknown:
+        deterministic or likely-to-repeat — fail fast."""
         if not can_retry:
             return None
         taken = [r["rung"] for r in rungs_taken]
         if kind == faults.DEVICE_OOM:
+            rec = self._budget_recommendation()
+            cur = self._explicit_window()
+            if (
+                "budget_shrink" not in taken
+                and rec is not None
+                # an explicit window already at/below the recommendation
+                # means the failed attempt ran it — re-applying the same
+                # value would be recover_retry with extra steps
+                and (not cur or int(cur) > int(rec))
+            ):
+                return "budget_shrink"
             if "recover_retry" not in taken:
                 return "recover_retry"
             if "shrink_union_window" not in taken:
@@ -201,20 +272,38 @@ class BenchReport:
 
     def _apply_rung(self, rung: str, kind: str, io_attempt: int):
         session = self.session
-        if rung in ("recover_retry", "shrink_union_window"):
+        if rung in ("recover_retry", "shrink_union_window", "budget_shrink"):
             if hasattr(session, "recover_memory"):
                 session.recover_memory(
                     "device memory exhausted"
                     if kind == faults.DEVICE_OOM
                     else "host memory exhausted"
                 )
+        if rung == "budget_shrink":
+            # consume the static prediction: retry with the budgeter's
+            # window instead of walking recover->halve blind. Only ever
+            # shrinks — a recommendation larger than an explicitly set
+            # window must not grow the degradation back out.
+            rec = self._budget_recommendation()
+            conf = getattr(session, "conf", None)
+            if conf is not None and rec:
+                cur = self._explicit_window()
+                new = min(int(cur), int(rec)) if cur else int(rec)
+                conf["engine.union_agg_window_rows"] = new
+                return {"window_rows": new}
+            return None
         if rung == "shrink_union_window":
-            # degrade persistently: halve an explicit window, else force a
-            # small one — every later query in this stream's session then
-            # routes blocked-union plans through bounded windows too
+            # degrade persistently: halve the window the failed attempt
+            # actually ran — the explicit conf, else the annotated static
+            # window (conf unset means the annotation was in effect), else
+            # force a small one — so every later query in this stream's
+            # session routes blocked-union plans through bounded windows
             conf = getattr(session, "conf", None)
             if conf is not None:
-                cur = conf.get("engine.union_agg_window_rows")
+                cur = self._explicit_window()
+                if not cur:
+                    pred = self._budget_prediction()
+                    cur = (pred or {}).get("window_rows")
                 new = max(int(cur) // 2, 4096) if cur else _DEGRADED_WINDOW_ROWS
                 conf["engine.union_agg_window_rows"] = new
                 return {"window_rows": new}
@@ -263,8 +352,76 @@ class BenchReport:
         rungs: list[dict] = []
         attempt_errors: list[str] = []
         # memory high-water sampling rides with tracing (observability is
-        # opt-in; an untraced run pays no sampler thread)
-        sampler = MemorySampler() if self.tracer is not None else None
+        # opt-in; an untraced run pays no sampler thread) OR with a
+        # configured host-RSS watermark (pre-emption needs the samples
+        # even when nothing is traced)
+        watermark = host_rss_watermark(self.session)
+        if hasattr(self.session, "_mem_pressure"):
+            self.session._mem_pressure = False
+        # hysteresis: RSS rarely drops back once crossed (allocators hold
+        # onto pages), so without this every later query's fresh sampler
+        # would re-fire on its first sample and re-halve the window down
+        # to the floor. One shrink per excursion: the latch only re-arms
+        # after a query starts BELOW the watermark again.
+        if watermark and getattr(self.session, "_rss_above_watermark", False):
+            from .obs.memwatch import rss_bytes
+
+            r = rss_bytes()
+            if r is not None and r < watermark:
+                self.session._rss_above_watermark = False
+
+        def _on_watermark(rss):
+            # sampler-thread callback, fired at most once per query: shrink
+            # the blocked-union window for the remaining windows (the
+            # executor's window loop polls _mem_pressure) and for every
+            # later statement of this session, and leave ladder evidence
+            session = self.session
+            if getattr(session, "_rss_above_watermark", False):
+                return  # same excursion as a previous query: already shrunk
+            session._rss_above_watermark = True
+            conf = getattr(session, "conf", None)
+            new = None
+            if conf is not None:
+                cur = self._explicit_window()
+                new = max(int(cur) // 2, 4096) if cur else _DEGRADED_WINDOW_ROWS
+                # never-grow invariant: an unset conf knob must not eclipse
+                # a smaller static budget_window_rows window (conf wins
+                # over the annotation in union_agg_window_rows), whether or
+                # not that window was already annotated into the plan
+                pred = self._budget_prediction()
+                rec = (pred or {}).get("window_rows")
+                if rec:
+                    new = min(new, int(rec))
+                conf["engine.union_agg_window_rows"] = new
+            if hasattr(session, "_mem_pressure"):
+                session._mem_pressure = True
+            rungs.append({
+                "rung": "host_watermark_shrink",
+                "kind": faults.HOST_OOM,
+                "rss_bytes": int(rss),
+                **({"window_rows": new} if new else {}),
+            })
+            if self.tracer is not None:
+                self.tracer.emit(
+                    "mem_watermark", query=self._name, rss_bytes=int(rss),
+                    watermark_bytes=watermark,
+                    **({"window_rows": new} if new else {}),
+                )
+            notify = getattr(session, "notify_failure", None)
+            if notify is not None:
+                notify(
+                    f"host RSS watermark crossed ({rss} >= {watermark}); "
+                    f"blocked-union window shrunk pre-emptively"
+                )
+
+        sampler = (
+            MemorySampler(
+                watermark_bytes=watermark or None,
+                on_watermark=_on_watermark if watermark else None,
+            )
+            if self.tracer is not None or watermark
+            else None
+        )
         try:
             if sampler is not None:
                 sampler.__enter__()
@@ -301,7 +458,10 @@ class BenchReport:
             if registered:
                 self.session.unregister_listener(failures.append)
         end_time = int(time.time() * 1000)
-        self.summary["retries"] = len(rungs)
+        # watermark pre-emption leaves ladder evidence but is not a retry
+        self.summary["retries"] = sum(
+            1 for r in rungs if r["rung"] != "host_watermark_shrink"
+        )
         if rungs:
             self.summary["ladder"] = rungs
         if err is None:
@@ -337,7 +497,7 @@ class BenchReport:
                 # against) must not jump with wall-clock adjustments
                 "dur_ms": round((time.perf_counter() - start_mono) * 1000, 3),
                 "status": self.summary["queryStatus"][-1],
-                "retries": len(rungs),
+                "retries": self.summary["retries"],
             }
             if err is not None:
                 ev["failure_kind"] = self.summary["failureKind"]

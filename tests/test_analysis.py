@@ -441,7 +441,8 @@ def test_plan_verify_events_emitted():
     evs = [e for e in s.tracer.events if e["kind"] == "plan_verify"]
     stages = [e["stage"] for e in evs]
     assert stages == [
-        "bind", "prune_columns", "mark_blocked_union_aggs", "mark_pipelines"
+        "bind", "prune_columns", "mark_blocked_union_aggs",
+        "mark_pipelines", "plan_budget",
     ]
     assert all(e["ok"] for e in evs)
     assert "plan_verify" in EVENT_SCHEMA

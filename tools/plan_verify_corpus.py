@@ -14,11 +14,22 @@ unit test's three queries miss is usually visible somewhere across the full
 99-template corpus, and verifying the corpus costs less than running one
 query.
 
+`--budget` adds the static-budgeter calibration pass (analysis/budget.py):
+every template is estimated schema-only against the SF1 AND SF10 TPC-DS
+catalogs, and the two load-bearing calibration points are gated — at SF1
+every statement must be admitted `direct` (SF1 is known to fit 103/103:
+zero false positives), and at SF10 the round-5 per-query map's device-OOM
+set (query5/6/7, BENCH_r05.json) must be flagged over-budget (>= 90%
+coverage). A model change that drifts either way fails CI here, not in a
+bench round. NDS_PLAN_BUDGET_STRICT is set for the whole run, so a
+budgeter crash on any template is a hard failure too.
+
 Usage:
     python tools/plan_verify_corpus.py [--queries 5,14,93] [--scale 1.0]
+    python tools/plan_verify_corpus.py --budget
 
-Exit status: 0 when every template binds, rewrites and verifies clean;
-1 otherwise (per-template failures listed). Wired into ci/tier1-check.
+Exit status: 0 when every template binds, rewrites and verifies clean (and
+the budget calibration holds); 1 otherwise. Wired into ci/tier1-check.
 """
 
 from __future__ import annotations
@@ -29,6 +40,8 @@ import sys
 from time import perf_counter
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# a budgeter crash on ANY template is a CI failure, not a degraded verdict
+os.environ.setdefault("NDS_PLAN_BUDGET_STRICT", "1")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
@@ -72,6 +85,82 @@ def check_template(sess: Session, qnum: int, scale: float, rngseed: int) -> int:
     return n
 
 
+#: the queries that device-OOM'd in the round-5 SF10 per-query map
+#: (BENCH_r05.json sf10.failed); the budgeter must flag >= 90% of them
+ROUND5_SF10_OOM = (5, 6, 7)
+
+_VERDICT_RANK = {"direct": 0, "unknown": 1, "blocked": 2, "over": 3,
+                 "reject": 4}
+
+
+def budget_pass(use_decimal: bool, rngseed: int) -> int:
+    """Schema-only budget estimates for every template at SF1 and SF10;
+    returns the number of calibration failures (0 == gate passes)."""
+    from nds_tpu.analysis import budget as B
+
+    failures = 0
+    for sf in (1.0, 10.0):
+        sess = build_session(use_decimal)
+        # analysis is explicit below; the in-session hook would reject
+        # over-budget SF10 templates before we could record their verdicts
+        sess.conf["engine.plan_budget"] = "off"
+        verdicts = {}
+        peaks = {}
+        t0 = perf_counter()
+        for q in available_templates():
+            rng = np.random.default_rng(np.random.SeedSequence([rngseed, 0]))
+            sql = instantiate(q, rng, sf)
+            worst = "direct"
+            peak = 0
+            for stmt in parse_script(sql):
+                res = sess.run_stmt(stmt)
+                pb = B.analyze_plan(
+                    res.plan, sess.catalog, scale_factor=sf
+                )
+                if _VERDICT_RANK[pb.verdict] > _VERDICT_RANK[worst]:
+                    worst = pb.verdict
+                peak = max(peak, pb.peak_bytes)
+            verdicts[q] = worst
+            peaks[q] = peak
+        dt = perf_counter() - t0
+        flagged = sorted(q for q, v in verdicts.items() if v != "direct")
+        print(
+            f"plan_budget_corpus: SF{sf:g}: {len(flagged)}/{len(verdicts)} "
+            f"templates flagged over-budget in {dt:.1f}s "
+            f"(max modeled peak {max(peaks.values()) / (1 << 30):.2f} GiB)"
+        )
+        if sf == 1.0:
+            if flagged:
+                failures += 1
+                print(
+                    f"plan_budget_corpus: FAIL: SF1 false positives "
+                    f"{flagged} (SF1 is known to fit 103/103; every "
+                    f"template must be admitted direct): "
+                    + ", ".join(
+                        f"q{q}={verdicts[q]}@{peaks[q] / (1 << 30):.2f}G"
+                        for q in flagged
+                    )
+                )
+        else:
+            hits = [q for q in ROUND5_SF10_OOM if verdicts[q] != "direct"]
+            coverage = len(hits) / len(ROUND5_SF10_OOM)
+            detail = ", ".join(
+                f"q{q}={verdicts[q]}@{peaks[q] / (1 << 30):.2f}G"
+                for q in ROUND5_SF10_OOM
+            )
+            print(
+                f"plan_budget_corpus: SF10 round-5 OOM set coverage "
+                f"{coverage:.0%} ({detail})"
+            )
+            if coverage < 0.9:
+                failures += 1
+                print(
+                    "plan_budget_corpus: FAIL: the budgeter must flag "
+                    ">= 90% of the round-5 SF10 device-OOM set"
+                )
+    return failures
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="bind + rewrite + verify all TPC-DS query templates"
@@ -85,6 +174,10 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--float", dest="floats", action="store_true",
         help="verify under the float (non-decimal) type mapping too",
+    )
+    ap.add_argument(
+        "--budget", action="store_true",
+        help="also run the static-budgeter SF1/SF10 calibration gate",
     )
     args = ap.parse_args(argv)
     qnums = (
@@ -115,6 +208,9 @@ def main(argv=None) -> int:
             f"{[q for q, _ in failures]}"
         )
         return 1
+    if args.budget:
+        if budget_pass(not args.floats, args.rngseed):
+            return 1
     return 0
 
 
