@@ -30,7 +30,15 @@ Every rule below encodes a bug this codebase actually shipped (and fixed):
                           must exist in obs/trace.py:EVENT_SCHEMA and pass
                           the kind's required fields (or forward **fields),
                           so schema drift breaks lint instead of the
-                          tolerant trace reader. Scope: everywhere.
+                          tolerant trace reader. Scope: everywhere. In
+                          obs/metrics.py the same rule also checks the
+                          LIVE-metric taxonomy: every family in
+                          METRIC_KINDS must map to a real EVENT_SCHEMA
+                          kind AND embed that kind in its name, and every
+                          literal metric name passed to a registry
+                          mutator must be a registered family — live
+                          metric names cannot drift from the event
+                          taxonomy (the PR-8 /metrics contract).
   undocumented-conf-knob  carry-forward hygiene: every `engine.*` conf key
                           the code reads must appear in the README knob
                           tables or a properties/ template — an invisible
@@ -339,6 +347,79 @@ def _r_trace_event_schema(tree, relpath):
             out.append((line, (
                 f"trace event {kind!r} missing required field(s) "
                 f"{sorted(missing)} (EVENT_SCHEMA contract)"
+            )))
+    if relpath == "obs/metrics.py":
+        out.extend(_metric_name_findings(tree, EVENT_SCHEMA))
+    return out
+
+
+#: MetricsRegistry mutators whose first argument is a metric family name
+_METRIC_MUTATORS = ("inc", "set_gauge", "max_gauge", "observe")
+
+
+def metric_kinds_literal(tree) -> dict:
+    """{family name: (source kind, lineno)} from the METRIC_KINDS dict
+    literal in obs/metrics.py's AST (empty when absent). Shared with the
+    golden-sync test that keeps the live-metric taxonomy anchored to
+    EVENT_SCHEMA."""
+    families = {}
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == "METRIC_KINDS"
+                for t in node.targets
+            )
+            and isinstance(node.value, ast.Dict)
+        ):
+            continue
+        for k, v in zip(node.value.keys, node.value.values):
+            if (
+                isinstance(k, ast.Constant) and isinstance(k.value, str)
+                and isinstance(v, ast.Constant) and isinstance(v.value, str)
+            ):
+                families[k.value] = (v.value, k.lineno)
+    return families
+
+
+def _metric_name_findings(tree, event_schema):
+    """obs/metrics.py half of the trace-event-schema rule: the live-metric
+    taxonomy must DERIVE from the event taxonomy. Every METRIC_KINDS entry
+    maps a family to a real EVENT_SCHEMA kind and embeds that kind in the
+    family name; every literal family name a registry mutator is called
+    with must be registered — a free-floating metric name cannot appear
+    on /metrics without first anchoring to an event kind."""
+    out = []
+    families = metric_kinds_literal(tree)
+    for name, (kind, line) in families.items():
+        if kind not in event_schema:
+            out.append((line, (
+                f"metric family {name!r} derives from {kind!r}, which is "
+                f"not an obs/trace.py:EVENT_SCHEMA kind — live metrics "
+                f"must anchor to the event taxonomy"
+            )))
+        elif kind not in name:
+            out.append((line, (
+                f"metric family {name!r} does not embed its source event "
+                f"kind {kind!r} in its name — free-floating metric names "
+                f"drift from the event taxonomy"
+            )))
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _METRIC_MUTATORS
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            continue
+        name = node.args[0].value
+        if name not in families:
+            out.append((node.lineno, (
+                f"metric name {name!r} is used in a registry mutator but "
+                f"not registered in METRIC_KINDS (family -> event kind); "
+                f"register it before exposing it"
             )))
     return out
 

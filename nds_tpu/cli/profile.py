@@ -7,13 +7,21 @@ event logs).
     python -m nds_tpu.cli.profile --compare OLD NEW
         [--ratio 1.25] [--min_ms 50] [--fail_on_regression]
         [--bench OLD_BENCH NEW_BENCH]
+    python -m nds_tpu.cli.profile compact <trace_dir> [--all] [--dry_run]
 
 Single-run mode aggregates one or more event logs (files or trace dirs —
 a throughput run's per-stream files profile together naturally) into
 per-query operator time/rows breakdowns, the top-N hottest operators
-across the run, and cache-hit/retry tallies. `--compare` diffs two runs
-and flags per-query and per-operator regressions. Exit codes: 0 ok,
-1 regressions found under --fail_on_regression, 2 malformed event log.
+across the run, and cache-hit/retry tallies; a (partially) compacted
+trace dir profiles transparently — raw segments and `compact-*.json`
+summary artifacts merge with identical summary semantics. `--compare`
+diffs two runs and flags per-query and per-operator regressions.
+`compact` folds closed rotation segments (engine.trace_rotate_bytes)
+into per-app summary artifacts and deletes the raw files, bounding a
+long-running fleet's trace-dir disk (--all folds the open tails too —
+post-run mode). Exit codes: 0 ok, 1 regressions found under
+--fail_on_regression (or segments skipped by compact), 2 malformed
+event log.
 """
 
 import argparse
@@ -36,19 +44,26 @@ def _fmt_ms(v):
     return "-" if v is None else f"{v:,.1f}"
 
 
-def _load(paths, check: bool):
+def _load_profile(paths, check: bool):
+    """Validated profile aggregate over raw event files + compaction
+    artifacts — one shared implementation (reader.load_profile); this
+    wrapper only adds the CLI's schema reporting and exit codes. Schema
+    validation applies to the raw events; artifacts were validated when
+    their segments folded (compact refuses schema-dirty segments)."""
+
+    def _validate(events):
+        problems = R.validate_events(events)
+        if problems:
+            for p in problems[:20]:
+                print(f"profile: schema: {p}", file=sys.stderr)
+            if check:
+                sys.exit(2)
+
     try:
-        events = R.read_events(paths, strict=True)
-    except (R.MalformedEventError, OSError) as exc:
+        return R.load_profile(paths, strict=True, events_hook=_validate)
+    except (R.MalformedEventError, OSError, ValueError, KeyError) as exc:
         print(f"profile: {exc}", file=sys.stderr)
         sys.exit(2)
-    problems = R.validate_events(events)
-    if problems:
-        for p in problems[:20]:
-            print(f"profile: schema: {p}", file=sys.stderr)
-        if check:
-            sys.exit(2)
-    return events
 
 
 def _render_profile(prof, top: int, per_query: bool):
@@ -228,7 +243,56 @@ def _render_compare(regs, ratio, min_ms):
                   f"{r['new_ms']:,.1f} ms ({r['ratio']:.2f}x)")
 
 
+def compact_main(argv=None) -> int:
+    """`profile compact`: fold closed rotation segments into summary
+    artifacts + drop the raw spans (obs.reader.compact_trace_dir)."""
+    parser = argparse.ArgumentParser(
+        prog="profile compact",
+        description="fold closed trace-rotation segments into per-app "
+        "compact-<app>.json summary artifacts and delete the raw files",
+    )
+    parser.add_argument("trace_dir", help="trace directory to compact")
+    parser.add_argument(
+        "--all", action="store_true", dest="fold_open",
+        help="also fold each chain's open tail segment (post-run "
+        "compaction; default keeps the highest-seq segment, which a "
+        "live tracer may still be appending to)",
+    )
+    parser.add_argument(
+        "--dry_run", action="store_true",
+        help="report what would fold without writing or deleting",
+    )
+    args = parser.parse_args(argv)
+    # --dry_run rides the SAME selection + readability classification as
+    # the real run (reader.compact_trace_dir) — the preview cannot drift
+    folded, skipped = R.compact_trace_dir(
+        args.trace_dir, fold_open=args.fold_open, dry_run=args.dry_run
+    )
+    for app, files in folded:
+        if args.dry_run:
+            for f in files:
+                print(f"compact: would fold {f}")
+        else:
+            print(
+                f"compact: {app}: folded {len(files)} segment(s) into "
+                f"compact-{app}.json"
+            )
+    for path, reason in skipped:
+        verb = "would skip" if args.dry_run else "skipped (left in place)"
+        print(f"compact: {verb} {path}: {reason}", file=sys.stderr)
+    if not folded and not skipped:
+        print("compact: nothing to fold")
+    return 1 if skipped else 0
+
+
 def main(argv=None):
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "compact":
+        rc = compact_main(argv[1:])
+        if rc:
+            sys.exit(rc)
+        return
     parser = argparse.ArgumentParser(
         description="aggregate nds-tpu event logs into operator-level "
         "profiles; compare two runs for regressions"
@@ -273,8 +337,8 @@ def main(argv=None):
     if args.compare or args.bench:
         regs = []
         if args.compare:
-            old_prof = R.profile_events(_load([args.compare[0]], args.check))
-            new_prof = R.profile_events(_load([args.compare[1]], args.check))
+            old_prof = _load_profile([args.compare[0]], args.check)
+            new_prof = _load_profile([args.compare[1]], args.check)
             regs = R.compare_profiles(
                 old_prof, new_prof, ratio=args.ratio, min_ms=args.min_ms
             )
@@ -290,7 +354,7 @@ def main(argv=None):
         return
     if not args.paths:
         parser.error("give event-log paths, or --compare OLD NEW")
-    prof = R.profile_events(_load(args.paths, args.check))
+    prof = _load_profile(args.paths, args.check)
     if args.as_json:
         print(json.dumps(prof, indent=2))
     else:

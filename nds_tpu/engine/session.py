@@ -479,10 +479,19 @@ class Session:
         # observability: with a trace dir configured (conf engine.trace_dir
         # / env NDS_TRACE_DIR) every executor, catalog load, and harness
         # report emits structured events into this session's own
-        # events-<appid>.jsonl; None = tracing disabled at zero cost
+        # events-<appid>.jsonl (rotating at engine.trace_rotate_bytes when
+        # set); None = tracing disabled at zero cost
         from ..obs.trace import tracer_from_conf
 
         self.tracer = tracer_from_conf(self.conf)
+        # live telemetry (obs/metrics.py + obs/httpserv.py): with
+        # engine.metrics_port / NDS_METRICS_PORT set, tracer_from_conf
+        # started the process-wide /metrics + /statusz endpoint and
+        # attached its MetricsSink to the tracer (building a sink-only
+        # tracer when no trace dir is configured) — one resolution path,
+        # so session.metrics and tracer.sink can never disagree. With
+        # neither knob set the hot path keeps its `tracer is None` check.
+        self.metrics = getattr(self.tracer, "sink", None)
         self.mesh = mesh
         self.catalog = Catalog(self)
         self._listeners = []  # task-failure observers (harness parity)

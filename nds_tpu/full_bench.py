@@ -434,7 +434,14 @@ def _run_phase(state: BenchState, name: str, skip, fn, tracer=None,
     attempt = 0
     t0 = time.perf_counter()
     if tracer is not None:
-        tracer.emit("phase", phase=name, event="begin")
+        # index/total ride the begin event so the live /statusz phase view
+        # (obs/metrics.py) can render orchestrator progress ("power_test,
+        # 4/8") without knowing the phase plan
+        idx = PHASES.index(name) + 1 if name in PHASES else None
+        tracer.emit(
+            "phase", phase=name, event="begin",
+            **({"index": idx, "total": len(PHASES)} if idx else {}),
+        )
     while True:
         attempt += 1
         pre_existing = set(obs_reader.discover_event_files(trace_dir))

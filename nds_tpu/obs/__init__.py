@@ -1,10 +1,17 @@
-"""Observability subsystem: structured event tracing + post-run profiling.
+"""Observability subsystem: structured event tracing, live telemetry,
+and post-run profiling.
 
 `trace` — the Tracer (JSON-lines event log, `NDS_TRACE_DIR` /
-`engine.trace_dir`), the golden event schema, and thread-local binding.
-`memwatch` — per-query device-memory/RSS high-water sampling.
-`reader` — event-log parsing, validation, fold-in summaries, operator
-aggregation, and A/B comparison (backing `nds_tpu/cli/profile.py`).
+`engine.trace_dir`, rotating at `engine.trace_rotate_bytes`), the golden
+event schema, and thread-local binding.
+`metrics` — the LIVE half: in-process counters/gauges/histograms fed
+from `Tracer.emit` plus the /statusz run status (`engine.metrics_port`).
+`httpserv` — the stdlib daemon-thread HTTP endpoint serving them.
+`memwatch` — per-query device-memory/RSS high-water sampling + the
+heartbeat liveness beacon.
+`reader` — event-log parsing, validation, segment-chain reassembly,
+fold-in summaries, operator aggregation, trace-dir compaction, and A/B
+comparison (backing `nds_tpu/cli/profile.py`).
 """
 
 from .trace import (  # noqa: F401
@@ -16,3 +23,10 @@ from .trace import (  # noqa: F401
     tracer_from_conf,
 )
 from .memwatch import MemorySampler  # noqa: F401
+from .metrics import (  # noqa: F401
+    METRIC_KINDS,
+    MetricsRegistry,
+    MetricsSink,
+    resolve_metrics_port,
+    validate_exposition,
+)

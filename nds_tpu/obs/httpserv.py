@@ -1,0 +1,94 @@
+"""The live-telemetry HTTP endpoint: /metrics, /statusz, /healthz.
+
+A stdlib `http.server` ThreadingHTTPServer on a daemon thread — no new
+dependency, nothing to install on a fleet node. Started by
+`obs.metrics.maybe_serve` when `engine.metrics_port` / NDS_METRICS_PORT
+is set (off by default; 0 binds an ephemeral port — the CI e2e reads it
+back from `MetricsServer.port`).
+
+    GET /metrics   Prometheus text exposition of the registry
+    GET /statusz   JSON run status: current phase, in-flight query with
+                   elapsed/attempt/ladder, completed/failed counts, cache
+                   hit rates, RSS + memory high-water, heartbeat age
+    GET /healthz   "ok" (liveness only; /statusz is the readiness story)
+
+The handler only READS sink state (every read path takes the sink's own
+locks), so a scrape can never block or corrupt the run it watches; the
+server thread is a daemon, so a finished benchmark process never hangs
+on it."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "nds-tpu-metrics"
+
+    def _reply(self, code, body, ctype):
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 (BaseHTTPRequestHandler contract)
+        sink = self.server.sink
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                self._reply(
+                    200, sink.registry.render(),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+            elif path == "/statusz":
+                self._reply(
+                    200, json.dumps(sink.status_snapshot(), default=str),
+                    "application/json",
+                )
+            elif path == "/healthz":
+                self._reply(200, "ok\n", "text/plain; charset=utf-8")
+            else:
+                self._reply(404, "not found\n", "text/plain; charset=utf-8")
+        except BrokenPipeError:
+            pass  # scraper hung up mid-reply: its problem, not the run's
+
+    def log_message(self, format, *args):  # noqa: A002 (stdlib signature)
+        pass  # a scrape every few seconds must not spam the bench stdout
+
+
+class MetricsServer:
+    """Daemon-thread HTTP server over one MetricsSink.
+
+    `port=0` binds ephemeral; the resolved port is `self.port`. Bind host
+    defaults to all interfaces (fleet scrapers live off-box) —
+    NDS_METRICS_HOST overrides (e.g. 127.0.0.1 on a shared dev machine)."""
+
+    def __init__(self, sink, port: int = 0, host: str | None = None):
+        if host is None:
+            host = os.environ.get("NDS_METRICS_HOST", "0.0.0.0")
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.sink = sink
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = None
+
+    def start(self) -> "MetricsServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="nds-obs-metrics-http",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)

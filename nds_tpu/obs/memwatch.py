@@ -9,12 +9,20 @@ also the signal the ROADMAP's host-OOM pre-emption item will watch).
 The sampler is a daemon thread started only while a traced query runs
 (BenchReport gates it on the session tracer), so with tracing off it costs
 nothing. Interval knob: NDS_TRACE_MEM_INTERVAL_MS (default 50).
+
+Heartbeats: because this thread is the one part of a query that keeps
+running while the query itself may be wedged, it doubles as the liveness
+beacon — with a tracer attached it emits a `heartbeat` event (query,
+elapsed_ms, rss_bytes) every NDS_HEARTBEAT_INTERVAL_MS (default 1000),
+so a hang is visible live (/statusz heartbeat age keeps ticking while
+in-flight elapsed grows) and classifiable post-hoc from the log tail.
 """
 
 from __future__ import annotations
 
 import os
 import threading
+import time
 
 _PAGE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
 
@@ -61,7 +69,8 @@ class MemorySampler:
     phenomenon even when device stats are the better high-water source."""
 
     def __init__(self, interval_s: float | None = None,
-                 watermark_bytes: int | None = None, on_watermark=None):
+                 watermark_bytes: int | None = None, on_watermark=None,
+                 tracer=None, query=None, heartbeat_s: float | None = None):
         if interval_s is None:
             interval_s = (
                 float(os.environ.get("NDS_TRACE_MEM_INTERVAL_MS", "50")) / 1000
@@ -72,6 +81,19 @@ class MemorySampler:
         self.watermark_bytes = watermark_bytes or None
         self.on_watermark = on_watermark
         self.watermark_fired = False
+        # heartbeat beacon (module docstring): emitted through `tracer`
+        # (passed explicitly — thread-locals don't reach this thread)
+        # at most every `heartbeat_s`; tracer None disables it
+        self.tracer = tracer
+        self.query = query
+        if heartbeat_s is None:
+            heartbeat_s = (
+                float(os.environ.get("NDS_HEARTBEAT_INTERVAL_MS", "1000"))
+                / 1000
+            )
+        self.heartbeat_s = max(heartbeat_s, 0.0)
+        self._last_hb = None
+        self._t0 = None
         self._stop = threading.Event()
         self._thread = None
         # probe once up front so source selection is stable for the run
@@ -83,7 +105,7 @@ class MemorySampler:
             self._read = None
 
     def _sample(self):
-        v = self._read()
+        v = self._read() if self._read is not None else None
         if v is not None and (self.peak_bytes is None or v > self.peak_bytes):
             self.peak_bytes = v
         if (
@@ -98,13 +120,30 @@ class MemorySampler:
                     self.on_watermark(r)
                 except Exception:
                     pass  # pre-emption must never take the query down
+        if self.tracer is not None and self.heartbeat_s:
+            now = time.monotonic()
+            if self._last_hb is None or now - self._last_hb >= self.heartbeat_s:
+                self._last_hb = now
+                r = v if self.source == "rss" else rss_bytes()
+                try:
+                    self.tracer.emit(
+                        "heartbeat",
+                        query=self.query,
+                        elapsed_ms=round((now - self._t0) * 1000, 1),
+                        rss_bytes=r,
+                    )
+                except Exception:
+                    pass  # the beacon must never take the query down
 
     def _loop(self):
         while not self._stop.wait(self.interval_s):
             self._sample()
 
     def __enter__(self):
-        if self._read is not None:
+        self._t0 = time.monotonic()
+        # the thread also runs with no readable memory signal when a
+        # tracer wants heartbeats: the beacon is about liveness, not bytes
+        if self._read is not None or self.tracer is not None:
             self._sample()
             self._thread = threading.Thread(
                 target=self._loop, name="nds-obs-memwatch", daemon=True

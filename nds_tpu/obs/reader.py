@@ -12,6 +12,7 @@ from __future__ import annotations
 import glob
 import json
 import os
+import re
 
 from .. import faults
 from .trace import EVENT_SCHEMA
@@ -31,12 +32,34 @@ class MalformedEventError(ValueError):
     which a crash legitimately leaves behind and readers skip)."""
 
 
+#: events-<app>.jsonl (segment 0) or events-<app>.<seq>.jsonl (rotation
+#: segments, Tracer._segment_path). Non-greedy app so a numeric suffix
+#: parses as the seq, not the app tail.
+_SEGMENT_RE = re.compile(r"^events-(?P<app>.+?)(?:\.(?P<seq>\d+))?\.jsonl$")
+
+
+def segment_key(path) -> tuple:
+    """(app id, rotation seq) of one event file — the chain-reassembly
+    sort key. Segment 0 is the un-suffixed classic name; rotation
+    segments carry a numeric seq. Unrecognized names sort by basename
+    with seq 0 (never rejected: discovery must stay tolerant)."""
+    base = os.path.basename(str(path))
+    m = _SEGMENT_RE.match(base)
+    if not m:
+        return (base, 0)
+    return (m.group("app"), int(m.group("seq") or 0))
+
+
 def discover_event_files(trace_dir) -> list:
-    """All event logs under a trace dir, sorted by name (name embeds the
-    app id, so order is stable across discovery calls)."""
+    """All event logs under a trace dir, ordered by (app id, rotation
+    seq) so each app's segment chain reads back in emission order (plain
+    name sort would put `events-a.0001.jsonl` BEFORE `events-a.jsonl`)."""
     if not trace_dir:
         return []
-    return sorted(glob.glob(os.path.join(str(trace_dir), "events-*.jsonl")))
+    return sorted(
+        glob.glob(os.path.join(str(trace_dir), "events-*.jsonl")),
+        key=segment_key,
+    )
 
 
 def iter_events(path, strict: bool = True):
@@ -44,7 +67,10 @@ def iter_events(path, strict: bool = True):
 
     A torn FINAL line (no trailing newline — the single-write+flush
     contract means only a crash mid-write can produce one) is skipped in
-    both modes. Any other malformed line raises MalformedEventError when
+    both modes; with rotation this classification is deliberately
+    PER-SEGMENT, so a crash that tore the final line of what later became
+    a non-final segment of its chain still reads as crash evidence, not
+    corruption. Any other malformed line raises MalformedEventError when
     `strict`, else is skipped."""
     with open(path, encoding="utf-8") as f:
         raw = f.read()
@@ -272,10 +298,13 @@ def profile_events(events) -> dict:
             if ev.get("failure_kind"):
                 q["failure_kind"] = ev["failure_kind"]
             if ev.get("mem_hw_bytes") is not None:
-                q["mem_hw_bytes"] = max(
-                    int(ev["mem_hw_bytes"]), q.get("mem_hw_bytes", 0)
-                )
-                q["mem_source"] = ev.get("mem_source")
+                # mem_source describes the run that HOLDS the high-water
+                # (merge_profiles mirrors this, so compacted and raw
+                # profiles of the same events agree on it)
+                v = int(ev["mem_hw_bytes"])
+                if "mem_hw_bytes" not in q or v > q["mem_hw_bytes"]:
+                    q["mem_hw_bytes"] = v
+                    q["mem_source"] = ev.get("mem_source")
         elif k == "plan_cache":
             tallies["plan_cache_hits" if ev.get("hit") else "plan_cache_misses"] += 1
         elif k == "catalog_load":
@@ -337,6 +366,271 @@ def exec_cache_hit_rate(prof: dict):
     if probes == 0:
         return None
     return t["exec_cache_hits"] / probes
+
+
+# ---------------------------------------------------------------------------
+# trace-dir compaction: fold closed rotation segments into summary artifacts
+# ---------------------------------------------------------------------------
+
+#: compaction summary artifact (one per app chain) — the pre-aggregated
+#: profile of the folded segments plus provenance
+COMPACT_PREFIX = "compact-"
+
+
+def discover_compact_files(trace_dir) -> list:
+    if not trace_dir:
+        return []
+    return sorted(
+        glob.glob(os.path.join(str(trace_dir), f"{COMPACT_PREFIX}*.json"))
+    )
+
+
+def read_compact(path) -> dict:
+    """One compaction artifact ({"compact": 1, "app", "segments",
+    "events", "profile"}); raises ValueError on a non-artifact OR an
+    artifact whose profile is structurally unusable (e.g. a torn/edited
+    file with "profile": null) — merge_profiles must never see it, so
+    every consumer fails through its ValueError path instead of an
+    AttributeError deep inside the merge."""
+    with open(path, encoding="utf-8") as f:
+        raw = json.load(f)
+    prof = raw.get("profile") if isinstance(raw, dict) else None
+    if not isinstance(raw, dict) or raw.get("compact") != 1 or not isinstance(
+        prof, dict
+    ):
+        raise ValueError(f"{path}: not a profile-compaction artifact")
+    for key in ("queries", "op_totals", "kernel_totals", "tallies",
+                "plan_budget"):
+        v = prof.get(key)
+        if v is None:
+            continue
+        bad = not isinstance(v, dict)
+        if not bad and key in ("queries", "op_totals", "kernel_totals"):
+            bad = any(not isinstance(x, dict) for x in v.values())
+        if not bad and key == "tallies":
+            bad = any(not isinstance(x, (int, float)) for x in v.values())
+        if bad:
+            raise ValueError(
+                f"{path}: compaction artifact with malformed "
+                f"profile[{key!r}]"
+            )
+    return raw
+
+
+def _merge_op(dst: dict, src: dict):
+    dst["count"] = dst.get("count", 0) + int(src.get("count") or 0)
+    dst["incl_ms"] = dst.get("incl_ms", 0.0) + float(src.get("incl_ms") or 0.0)
+    dst["excl_ms"] = dst.get("excl_ms", 0.0) + float(src.get("excl_ms") or 0.0)
+    dst["rows"] = dst.get("rows", 0) + int(src.get("rows") or 0)
+
+
+def merge_profiles(base: dict, extra: dict) -> dict:
+    """Merge two `profile_events` aggregates with the SAME multi-stream
+    semantics profiling the raw files together would give: per-query
+    wall/runs/operator times SUM, any Failed run surfaces, memory
+    high-water is the max, tallies/verdict counts add. This is what makes
+    `profile` over a compacted trace dir equal the uncompacted profile
+    for the summary fields. Returns `base`, mutated."""
+    for q, src in (extra.get("queries") or {}).items():
+        dst = base.setdefault("queries", {}).setdefault(
+            q, dict(_EMPTY_QUERY, ops={})
+        )
+        if src.get("wall_ms") is not None:
+            dst["wall_ms"] = (dst.get("wall_ms") or 0.0) + float(src["wall_ms"])
+        dst["runs"] = dst.get("runs", 0) + int(src.get("runs") or 0)
+        dst["root_incl_ms"] = (
+            dst.get("root_incl_ms", 0.0) + float(src.get("root_incl_ms") or 0.0)
+        )
+        if dst.get("status") != "Failed":  # any failed run surfaces
+            dst["status"] = src.get("status") or dst.get("status")
+        if src.get("failure_kind"):
+            dst["failure_kind"] = src["failure_kind"]
+        if src.get("mem_hw_bytes") is not None and (
+            "mem_hw_bytes" not in dst
+            or int(src["mem_hw_bytes"]) > int(dst.get("mem_hw_bytes") or 0)
+        ):
+            dst["mem_hw_bytes"] = int(src["mem_hw_bytes"])
+            dst["mem_source"] = src.get("mem_source")
+        for node, op in (src.get("ops") or {}).items():
+            _merge_op(
+                dst["ops"].setdefault(
+                    node,
+                    {"count": 0, "incl_ms": 0.0, "excl_ms": 0.0, "rows": 0},
+                ),
+                op,
+            )
+    for name, src in (extra.get("op_totals") or {}).items():
+        _merge_op(base.setdefault("op_totals", {}).setdefault(name, {}), src)
+    for name, src in (extra.get("kernel_totals") or {}).items():
+        dst = base.setdefault("kernel_totals", {}).setdefault(name, {})
+        dst["count"] = dst.get("count", 0) + int(src.get("count") or 0)
+        dst["dur_ms"] = dst.get("dur_ms", 0.0) + float(src.get("dur_ms") or 0.0)
+        dst["n_rows"] = dst.get("n_rows", 0) + int(src.get("n_rows") or 0)
+    for name, v in (extra.get("tallies") or {}).items():
+        base.setdefault("tallies", {})
+        base["tallies"][name] = base["tallies"].get(name, 0) + v
+    pb_src = extra.get("plan_budget") or {}
+    pb_dst = base.setdefault(
+        "plan_budget",
+        {"verdicts": {}, "max_peak_bytes": 0, "max_budget_bytes": 0},
+    )
+    for v, n in (pb_src.get("verdicts") or {}).items():
+        pb_dst["verdicts"][v] = pb_dst["verdicts"].get(v, 0) + n
+    for key in ("max_peak_bytes", "max_budget_bytes"):
+        pb_dst[key] = max(pb_dst.get(key, 0), int(pb_src.get(key) or 0))
+    return base
+
+
+def load_profile(paths, strict: bool = True, events_hook=None) -> dict:
+    """The profile aggregate of raw event files AND compaction artifacts
+    under `paths` — `profile_events` over the events, then every
+    artifact's saved profile merged in. THE one implementation of
+    "profile a (partially) compacted dir": the profiler CLI routes here
+    too, passing `events_hook(events)` to schema-validate the raw half
+    before aggregation (artifacts were validated when their segments
+    folded — compact_trace_dir refuses schema-dirty segments).
+
+    Safe against a CONCURRENT `profile compact` of the same dir (the
+    documented fleet mode): dir-discovered segments are read first and
+    individually tolerate vanishing mid-read (the compactor deleted them
+    — their events are in the artifact, whose atomic commit strictly
+    precedes the delete), artifacts are discovered AFTER the reads, and
+    any raw segment that both got read AND appears in an artifact's
+    `segments` provenance is dropped from the raw half before profiling
+    (same dedup that makes a crashed compactor's half-state count once).
+    Explicitly named files keep strict semantics — a missing path the
+    caller asked for is still an error."""
+    if isinstance(paths, (str, os.PathLike)):
+        paths = [paths]
+    events, compacts = [], []
+    per_seg = []  # (basename, events) of dir-discovered segments
+    for p in paths:
+        p = str(p)
+        if os.path.isdir(p):
+            for f in discover_event_files(p):
+                try:
+                    per_seg.append(
+                        (os.path.basename(f), list(iter_events(f, strict=strict)))
+                    )
+                except FileNotFoundError:
+                    pass  # raced a concurrent compact: folded into an artifact
+            compacts.extend(discover_compact_files(p))
+        elif os.path.basename(p).startswith(COMPACT_PREFIX) and p.endswith(
+            ".json"
+        ):
+            compacts.append(p)
+        else:
+            events.extend(iter_events(p, strict=strict))
+    artifacts = [read_compact(c) for c in compacts]
+    folded = set()
+    for a in artifacts:
+        folded.update(a.get("segments") or [])
+    for base, evs in per_seg:
+        if base not in folded:  # read raw AND folded would count twice
+            events.extend(evs)
+    if events_hook is not None:
+        events_hook(events)
+    prof = profile_events(events)
+    for a in artifacts:
+        merge_profiles(prof, a["profile"])
+    return prof
+
+
+def compact_trace_dir(trace_dir, fold_open: bool = False,
+                      dry_run: bool = False):
+    """Fold rotation segments into per-app `compact-<app>.json` summary
+    artifacts and DELETE the folded raw files, bounding a long-running
+    fleet's event-log disk at ~one open segment per live app.
+
+    By default only CLOSED segments fold (everything but each chain's
+    highest-seq segment, which a live tracer may still be appending to);
+    `fold_open=True` folds whole chains (post-run compaction). Re-running
+    merges new closed segments into the existing artifact. A segment with
+    mid-file corruption is left in place for forensics and reported in
+    `skipped` — compaction never destroys evidence it could not read.
+
+    Crash safety: the artifact commits atomically BEFORE the raw deletes,
+    and its `segments` provenance list is consulted on the next run — a
+    segment whose basename is already recorded was folded by a run that
+    died mid-delete, so it is removed without re-merging (no double
+    count, ever).
+
+    `dry_run` runs the exact same selection + readability classification
+    but writes and deletes nothing (the `profile compact --dry_run`
+    preview shares this one implementation so it cannot drift).
+
+    Returns (folded, skipped): folded = [(app, [paths])...],
+    skipped = [(path, reason)...]."""
+    from ..io.fs import fs_open_atomic
+
+    chains = {}
+    for f in discover_event_files(trace_dir):
+        app, seq = segment_key(f)
+        chains.setdefault(app, []).append((seq, f))
+    folded, skipped = [], []
+    for app, segs in sorted(chains.items()):
+        segs.sort()
+        victims = [f for _, f in (segs if fold_open else segs[:-1])]
+        if not victims:
+            continue
+        artifact = os.path.join(str(trace_dir), f"{COMPACT_PREFIX}{app}.json")
+        try:
+            prior = read_compact(artifact) if os.path.exists(artifact) else None
+        except (OSError, ValueError) as exc:
+            # an unreadable/foreign prior artifact: folding into it would
+            # overwrite whatever it held — skip this chain, keep going on
+            # the others (a fleet's disk must not hinge on one bad file)
+            skipped.append((artifact, str(exc)))
+            continue
+        already = set((prior or {}).get("segments") or [])
+        stale = [f for f in victims if os.path.basename(f) in already]
+        victims = [f for f in victims if os.path.basename(f) not in already]
+        if not dry_run:
+            for f in stale:
+                os.remove(f)  # folded by a crashed run: finish its delete
+        events, ok_files = [], []
+        for f in victims:
+            try:
+                evs = list(iter_events(f, strict=True))
+            except MalformedEventError as exc:
+                skipped.append((f, str(exc)))
+                continue
+            # schema-validate BEFORE folding: an artifact only ever holds
+            # schema-clean events, so `profile --check` keeps its teeth
+            # over compacted dirs (the raw spans it would have flagged are
+            # left in place and reported instead of silently absorbed)
+            problems = validate_events(evs)
+            if problems:
+                skipped.append((f, f"schema: {problems[0]}"))
+                continue
+            events.extend(evs)
+            ok_files.append(f)
+        if not ok_files:
+            if stale:
+                folded.append((app, stale))
+            continue
+        if dry_run:
+            folded.append((app, stale + ok_files))
+            continue
+        prof = profile_events(events)
+        if prior is not None:
+            # merge INTO the prior profile so repeated compaction rounds
+            # accumulate exactly like one bigger round would have
+            prof = merge_profiles(prior["profile"], prof)
+        payload = {
+            "compact": 1,
+            "app": app,
+            "segments": sorted(already)
+            + [os.path.basename(f) for f in ok_files],
+            "events": int((prior or {}).get("events") or 0) + len(events),
+            "profile": prof,
+        }
+        with fs_open_atomic(artifact, "w") as fh:
+            json.dump(payload, fh)
+        for f in ok_files:
+            os.remove(f)
+        folded.append((app, stale + ok_files))
+    return folded, skipped
 
 
 def compare_profiles(old: dict, new: dict, ratio: float = 1.25,

@@ -5,9 +5,13 @@ event logs, per-task metrics, and the RAPIDS profiling/qualification tools
 that post-process them. This engine has no Spark underneath, so the
 equivalent seam lives here: a `Tracer` appends JSON-lines events to
 `events-<appid>.jsonl` under a trace directory (`NDS_TRACE_DIR` env / conf
-`engine.trace_dir`), one self-contained JSON object per line, and
+`engine.trace_dir`), one self-contained JSON object per line — rotating to
+`events-<appid>.<seq>.jsonl` segments at `engine.trace_rotate_bytes` so
+long-running fleets can compact closed segments (`profile compact`) — and
 `nds_tpu/cli/profile.py` is the post-processor (the local analogue of the
-reference's profiling tool over Spark event logs).
+reference's profiling tool over Spark event logs). The LIVE half is
+`obs/metrics.py`: an optional MetricsSink on the same emit seam feeds the
+`/metrics` + `/statusz` endpoint while the run is still going.
 
 Zero-cost contract: with no trace dir configured, `tracer_from_conf` returns
 None, `Session.tracer` is None, and every instrumentation point in the hot
@@ -90,6 +94,12 @@ EVENT_SCHEMA = {
     # (report.py; shrinks the blocked-union window before the allocator
     # fails)
     "mem_watermark": ("rss_bytes", "watermark_bytes"),
+    # liveness beacon from the per-query memory-sampler thread
+    # (obs/memwatch.py, armed by report.py while a traced query runs):
+    # a hung query keeps heartbeating, so the hang is visible live on
+    # /statusz (heartbeat age + in-flight elapsed) and classifiable
+    # post-hoc from the log tail. Interval: NDS_HEARTBEAT_INTERVAL_MS.
+    "heartbeat": ("query", "elapsed_ms", "rss_bytes"),
 }
 
 #: kinds kept in EVENT_SCHEMA for old-log readers but no longer emitted by
@@ -121,6 +131,25 @@ def resolve_kernel_trace(conf: dict | None = None) -> bool:
     return str(v).lower() in ("1", "on", "true") if v is not None else False
 
 
+def resolve_rotate_bytes(conf: dict | None = None) -> int:
+    """Trace-segment rotation threshold in bytes (conf
+    `engine.trace_rotate_bytes`, env NDS_TRACE_ROTATE_BYTES); 0 — the
+    default — disables rotation (one `events-<appid>.jsonl` forever, the
+    pre-rotation behavior). With a threshold, the tracer rolls to
+    `events-<appid>.<seq>.jsonl` segments so long-running fleets can
+    compact closed segments (`profile compact`) instead of growing one
+    unbounded log."""
+    v = None
+    if conf:
+        v = conf.get("engine.trace_rotate_bytes")
+    if v is None:
+        v = os.environ.get("NDS_TRACE_ROTATE_BYTES")
+    try:
+        return max(int(v), 0) if v else 0
+    except (TypeError, ValueError):
+        return 0
+
+
 def default_app_id() -> str:
     """Unique per-tracer app id: pid + epoch second + random suffix (two
     thread-mode throughput streams in one process must not collide)."""
@@ -129,46 +158,104 @@ def default_app_id() -> str:
 
 class Tracer:
     """Append-only JSON-lines event writer (or an in-memory collector when
-    `trace_dir` is None — the dev-tool mode tools/trace_query.py uses).
+    `trace_dir` is None — the dev-tool mode tools/trace_query.py uses; or
+    a sink-only forwarder with `collect=False` — the live-telemetry-
+    without-a-trace-dir mode).
 
     Thread-safe: a lock serializes writes, and each event line is emitted
     with a single write() + flush so concurrent streams/threads sharing a
-    tracer never interleave mid-line."""
+    tracer never interleave mid-line.
+
+    Rotation: with `rotate_bytes` set the tracer rolls to a new segment
+    (`events-<appid>.<seq>.jsonl`, seq 1..) once the current one reaches
+    the threshold; every segment opens with its own `trace_meta` line so
+    each file is independently discoverable/attributable. Segment 0 keeps
+    the classic un-suffixed name, so unrotated runs look exactly as
+    before. `obs.reader` reassembles chains in seq order.
+
+    Lifecycle: `close()` is terminal — a late emit after close is a
+    harness-ordering bug and becomes a NO-OP with a one-shot warning
+    (historically it silently reopened the file and leaked the handle)."""
 
     def __init__(self, trace_dir: str | None = None, app_id: str | None = None,
-                 kernel_spans: bool = False):
+                 kernel_spans: bool = False, sink=None, rotate_bytes: int = 0,
+                 collect: bool | None = None):
         self.app_id = app_id or default_app_id()
         self.trace_dir = trace_dir
         # opt-in per-kernel dispatch timing: the ops.kernels instrumentation
         # only fires when the thread-bound tracer carries this flag
         self.kernel_spans = kernel_spans
-        self.path = (
-            os.path.join(trace_dir, f"events-{self.app_id}.jsonl")
-            if trace_dir
-            else None
+        # live-telemetry bridge (obs/metrics.py): every emitted event also
+        # updates the sink's counters/status; None = no live metrics
+        self.sink = sink
+        self.rotate_bytes = max(int(rotate_bytes or 0), 0)
+        self.seq = 0
+        self.path = self._segment_path(0) if trace_dir else None
+        if collect is None:
+            collect = trace_dir is None
+        self.events: list[dict] | None = (
+            [] if (trace_dir is None and collect) else None
         )
-        self.events: list[dict] | None = None if trace_dir else []
         self._fh = None
         self._lock = threading.Lock()
         self._broken = False
+        self._closed = False
+        self._close_warned = False
+        self._seg_bytes = 0
         if trace_dir:
             # eager meta line: the file exists (and is discoverable by a
             # parent/orchestrator) even if the process dies before its
             # first real event
             self.emit("trace_meta", pid=os.getpid(), version=__version__)
 
+    def _segment_path(self, seq: int) -> str:
+        if seq == 0:
+            return os.path.join(self.trace_dir, f"events-{self.app_id}.jsonl")
+        # zero-padded so chains stay scannable by eye; ordering itself is
+        # parsed, not lexicographic (obs.reader.segment_key)
+        return os.path.join(
+            self.trace_dir, f"events-{self.app_id}.{seq:04d}.jsonl"
+        )
+
     # ------------------------------------------------------------------
     def emit(self, kind: str, **fields):
         """Record one event. `ts`/`kind`/`app` are added here; `query` is
         added from the active faults.scope when the caller didn't pass it."""
+        if self._closed:
+            # emit-after-close: a harness loop closed this tracer before
+            # some late worker finished. Dropping is correct (the reader
+            # contract says a closed file is final); reopening would leak
+            # the handle and resurrect a file a parent may already have
+            # folded in.
+            with self._lock:
+                if not self._close_warned:
+                    self._close_warned = True
+                    print(
+                        f"obs: tracer {self.app_id} got an emit({kind!r}) "
+                        f"after close(); dropping this and later events "
+                        f"(close tracers only after their last emitter)"
+                    )
+            return
         ev = {"ts": int(time.time() * 1000), "kind": kind, "app": self.app_id}
         if "query" not in fields:
             scope = faults.current_scope()
             if scope is not None:
                 ev["query"] = scope
         ev.update(fields)
-        line = json.dumps(ev, default=str)
+        if self.sink is not None:
+            try:
+                self.sink.record(ev)
+            except Exception:
+                pass  # live telemetry must never take the benchmark down
+        if self.path is None and self.events is None:
+            return  # sink-only mode: nothing to persist
+        # serialize outside the lock (sink-only mode skipped it above)
+        line = json.dumps(ev, default=str) if self.path is not None else None
         with self._lock:
+            if self._closed:
+                return  # raced a concurrent close(): the unlocked check
+                # above passed before close() took the lock — reopening
+                # here would resurrect the leak this check exists to kill
             if self.events is not None:
                 self.events.append(ev)
                 return
@@ -180,28 +267,73 @@ class Tracer:
                     if parent:
                         os.makedirs(parent, exist_ok=True)
                     self._fh = open(self.path, "a", encoding="utf-8")
-                self._fh.write(line + "\n")
+                    self._seg_bytes = os.fstat(self._fh.fileno()).st_size
+                data = line + "\n"
+                self._fh.write(data)
                 self._fh.flush()
+                if self.rotate_bytes:
+                    # byte accounting (an extra encode per line) only when
+                    # rotation can actually consume it
+                    self._seg_bytes += len(data.encode("utf-8"))
+                    if self._seg_bytes >= self.rotate_bytes:
+                        self._rotate()
             except OSError as exc:
                 # observability must never take the benchmark down: an
                 # unwritable trace dir disables this tracer, loudly, once
                 self._broken = True
                 print(f"obs: disabling tracer ({self.path}: {exc})")
 
+    def _rotate(self):
+        """Roll to the next segment (caller holds the lock). The new
+        segment opens with its own trace_meta line (carrying `seq`) so a
+        segment file found alone is still attributable to its process."""
+        self._fh.close()
+        self.seq += 1
+        self.path = self._segment_path(self.seq)
+        self._fh = open(self.path, "a", encoding="utf-8")
+        meta = json.dumps({
+            "ts": int(time.time() * 1000), "kind": "trace_meta",
+            "app": self.app_id, "pid": os.getpid(),
+            "version": __version__, "seq": self.seq,
+        })
+        self._fh.write(meta + "\n")
+        self._fh.flush()
+        self._seg_bytes = len(meta.encode("utf-8")) + 1
+
     def close(self):
+        """Terminal: flush + release the file handle and refuse later
+        emits (see class docstring). Idempotent."""
         with self._lock:
+            self._closed = True
             if self._fh is not None:
                 self._fh.close()
                 self._fh = None
 
 
 def tracer_from_conf(conf: dict | None = None, app_id: str | None = None):
-    """A file-backed Tracer when a trace dir is configured, else None (the
-    zero-cost disabled state every instrumentation point checks for)."""
+    """A Tracer when observability is configured, else None (the zero-cost
+    disabled state every instrumentation point checks for).
+
+    Three live shapes: a trace dir alone gives the classic file tracer; a
+    metrics port alone gives a SINK-ONLY tracer (no file, no in-memory
+    list — emission sites fire so the live registry stays hot, nothing is
+    persisted); both give a file tracer that also feeds the sink."""
     d = resolve_trace_dir(conf)
+    # lazy: obs.metrics imports EVENT_SCHEMA from this module
+    from . import metrics as obs_metrics
+
+    sink = obs_metrics.maybe_serve(conf)
     if not d:
-        return None
-    return Tracer(d, app_id=app_id, kernel_spans=resolve_kernel_trace(conf))
+        if sink is None:
+            return None
+        return Tracer(
+            None, app_id=app_id, kernel_spans=resolve_kernel_trace(conf),
+            sink=sink, collect=False,
+        )
+    return Tracer(
+        d, app_id=app_id, kernel_spans=resolve_kernel_trace(conf),
+        sink=sink, rotate_bytes=resolve_rotate_bytes(conf),
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -189,7 +189,29 @@ def run_query_stream(
         conf["engine.mesh_devices"] = mesh_devices
     session = Session(use_decimal=use_decimal, conf=conf, mesh=mesh)
     app_id = f"nds-tpu-{os.getpid()}-{int(total_time_start)}"
+    try:
+        return _run_query_stream_body(
+            session, app_id, total_start_mono, input_prefix, property_file,
+            query_dict, time_log_output_path, extra_time_log_output_path,
+            sub_queries, input_format, use_decimal, output_path,
+            output_format, json_summary_folder, keep_session, start_gate,
+            execution_time_list,
+        )
+    finally:
+        # the stream is this tracer's ONLY emitter: closing here (success
+        # or crash) releases the handle and flushes the final line; a late
+        # emit after this point is a harness bug the tracer now drops
+        # loudly instead of silently reopening the file (obs/trace.py)
+        if not keep_session and session.tracer is not None:
+            session.tracer.close()
 
+
+def _run_query_stream_body(
+    session, app_id, total_start_mono, input_prefix, property_file,
+    query_dict, time_log_output_path, extra_time_log_output_path,
+    sub_queries, input_format, use_decimal, output_path, output_format,
+    json_summary_folder, keep_session, start_gate, execution_time_list,
+):
     execution_time_list = setup_tables(
         session, input_prefix, input_format, use_decimal, execution_time_list, app_id
     )

@@ -110,6 +110,11 @@ class BenchReport:
     def __init__(self, session) -> None:
         self.session = session
         self.tracer = getattr(session, "tracer", None)
+        # live telemetry (obs/metrics.py): the sink learns query STARTS
+        # directly (query_span only exists at the end — too late for
+        # /statusz's in-flight view); everything else reaches it through
+        # the tracer's emit seam
+        self.sink = getattr(session, "metrics", None)
         self.summary = {
             "env": {
                 "envVars": {},
@@ -418,10 +423,22 @@ class BenchReport:
             MemorySampler(
                 watermark_bytes=watermark or None,
                 on_watermark=_on_watermark if watermark else None,
+                # the sampler thread doubles as the liveness beacon: it
+                # heartbeats through the tracer (passed explicitly —
+                # thread-locals don't reach the sampler thread) so a hung
+                # attempt stays visible on /statusz and in the log tail
+                tracer=self.tracer,
+                query=name,
             )
             if self.tracer is not None or watermark
             else None
         )
+        if self.sink is not None:
+            # the app id keys the sink's in-flight record to THIS stream's
+            # events (concurrent streams may run the same query name)
+            self.sink.query_started(
+                name, app=getattr(self.tracer, "app_id", None)
+            )
         try:
             if sampler is not None:
                 sampler.__enter__()

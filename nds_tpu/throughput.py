@@ -227,7 +227,11 @@ def _fold_child_streams(tracer, trace_dir, pre_existing, procs):
     """Fold the event files the child-stream processes wrote into the
     parent's own event log: one `child_stream` summary event per stream,
     plus a best-effort failure classification per stream (the parent only
-    sees an exit code; the child's events say WHY it died). Returns
+    sees an exit code; the child's events say WHY it died). A child that
+    rotated (engine.trace_rotate_bytes) leaves a SEGMENT CHAIN; discovery
+    returns it in rotation order (obs.reader.segment_key) and the filter
+    below preserves that order, so the summary and the classification
+    read the child's whole stream in emission order. Returns
     {stream_num: failure_kind} for streams whose events record a failure."""
     from .obs import reader as obs_reader
 
@@ -238,7 +242,9 @@ def _fold_child_streams(tracer, trace_dir, pre_existing, procs):
         if f not in pre_existing
     ]
     for n, (p, _logf) in sorted(procs.items()):
-        # the child's app id embeds its pid (events-nds-tpu-<pid>-...)
+        # the child's app id embeds its pid (events-nds-tpu-<pid>-...);
+        # all rotation segments of one chain share the app id, so the
+        # pid match collects the full chain
         mine = [f for f in new if f"-{p.pid}-" in os.path.basename(f)]
         if not mine:
             continue

@@ -564,6 +564,53 @@ def test_lint_trace_event_schema():
     assert L.lint_source(good, "engine/exec.py") == []
 
 
+def test_lint_metric_names_derive_from_event_kinds():
+    """trace-event-schema's obs/metrics.py half: the live-metric taxonomy
+    must anchor to EVENT_SCHEMA (ISSUE 8 satellite)."""
+    # family mapped to a kind that is not in EVENT_SCHEMA
+    bad_kind = 'METRIC_KINDS = {"nds_bogus_total": "bogus"}\n'
+    fs = L.lint_source(bad_kind, "obs/metrics.py")
+    assert [f.rule for f in fs] == ["trace-event-schema"]
+    assert "not an obs/trace.py:EVENT_SCHEMA kind" in fs[0].message
+    # family whose name does not embed its source kind
+    free = 'METRIC_KINDS = {"nds_free_total": "query_span"}\n'
+    fs = L.lint_source(free, "obs/metrics.py")
+    assert fs and "does not embed its source event kind" in fs[0].message
+    # a registry mutator called with an unregistered literal name
+    unreg = (
+        'METRIC_KINDS = {"nds_query_span_total": "query_span"}\n'
+        'reg.inc("nds_query_span_total", status=s)\n'
+        'reg.inc("nds_rogue_total")\n'
+    )
+    fs = L.lint_source(unreg, "obs/metrics.py")
+    assert len(fs) == 1 and "nds_rogue_total" in fs[0].message
+    # the same source outside obs/metrics.py is not metric-checked
+    assert L.lint_source(bad_kind, "obs/reader.py") == []
+    # clean: derived names, registered mutator calls
+    clean = (
+        'METRIC_KINDS = {"nds_exec_cache_total": "exec_cache"}\n'
+        'reg.inc("nds_exec_cache_total", result="hit")\n'
+    )
+    assert L.lint_source(clean, "obs/metrics.py") == []
+
+
+def test_metric_kinds_sync_with_event_schema():
+    """Golden sync for the live-metric taxonomy: the shipped METRIC_KINDS
+    maps every family to a live EVENT_SCHEMA kind and embeds the kind in
+    the family name — and the AST view the lint rule checks agrees with
+    the runtime dict (no drift between what lint sees and what runs)."""
+    from nds_tpu.obs.metrics import METRIC_KINDS
+
+    for name, kind in METRIC_KINDS.items():
+        assert kind in EVENT_SCHEMA, (name, kind)
+        assert kind in name, (name, kind)
+    path = os.path.join(L.package_root(), "obs", "metrics.py")
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    parsed = {k: v for k, (v, _line) in L.metric_kinds_literal(tree).items()}
+    assert parsed == dict(METRIC_KINDS)
+
+
 def test_lint_clean_over_real_tree():
     findings = L.run_lint()
     assert findings == [], "\n".join(str(f) for f in findings)

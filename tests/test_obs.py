@@ -23,6 +23,7 @@ from nds_tpu import full_bench as FB
 from nds_tpu import throughput as TP
 from nds_tpu.cli import profile as profile_cli
 from nds_tpu.engine.session import Session
+from nds_tpu.obs import metrics as M
 from nds_tpu.obs import reader as R
 from nds_tpu.obs.memwatch import MemorySampler
 from nds_tpu.obs.trace import EVENT_SCHEMA, Tracer, bind, tracer_from_conf
@@ -36,9 +37,23 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def _clean_env(monkeypatch):
     monkeypatch.delenv("NDS_TRACE_DIR", raising=False)
     monkeypatch.delenv("NDS_FAULT_SPEC", raising=False)
+    monkeypatch.delenv("NDS_METRICS_PORT", raising=False)
+    monkeypatch.delenv("NDS_TRACE_ROTATE_BYTES", raising=False)
     faults.reset()
     yield
     faults.reset()
+    # the metrics sink/server are process-wide singletons by design; tests
+    # must not leak one test's counters (or a bound port) into the next
+    M.reset_shared()
+
+
+def _scrape(port, path):
+    import urllib.request
+
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=5
+    ) as resp:
+        return resp.read().decode("utf-8")
 
 
 def _events(path_or_dir):
@@ -578,6 +593,511 @@ def test_profile_cli_check_flags_schema_problems(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# live telemetry: registry, sink, HTTP endpoint
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_registry_counters_gauges_histograms():
+    reg = M.MetricsRegistry()
+    reg.inc("nds_exec_cache_total", result="hit")
+    reg.inc("nds_exec_cache_total", result="hit")
+    reg.inc("nds_exec_cache_total", result="miss")
+    reg.set_gauge("nds_heartbeat_rss_bytes", 100)
+    reg.set_gauge("nds_heartbeat_rss_bytes", 50)  # gauges move both ways
+    reg.max_gauge("nds_query_span_mem_hw_bytes", 10)
+    reg.max_gauge("nds_query_span_mem_hw_bytes", 5)  # high-water ratchets
+    reg.observe("nds_query_span_dur_ms", 3.0)
+    reg.observe("nds_query_span_dur_ms", 999999.0)  # lands in +Inf, bounded
+    assert reg.counter_value("nds_exec_cache_total", result="hit") == 2
+    text = reg.render()
+    assert M.validate_exposition(text) == []
+    assert 'nds_exec_cache_total{result="hit"} 2' in text
+    assert "nds_heartbeat_rss_bytes 50" in text
+    assert "nds_query_span_mem_hw_bytes 10" in text
+    assert 'nds_query_span_dur_ms_bucket{le="+Inf"} 2' in text
+    assert "nds_query_span_dur_ms_count 2" in text
+    # free-floating metric names are refused at runtime (lint's belt)
+    with pytest.raises(ValueError):
+        reg.inc("nds_made_up_total")
+    # every registered family name embeds its source event kind
+    for name, kind in M.METRIC_KINDS.items():
+        assert kind in EVENT_SCHEMA and kind in name
+
+
+def test_validate_exposition_flags_malformed():
+    assert M.validate_exposition("# TYPE a counter\na 1\n") == []
+    probs = M.validate_exposition(
+        "# TYPE a counter\na{x=unquoted} 1\nb 2\nnot a line\n"
+    )
+    assert len(probs) == 3  # bad labels, undeclared family, junk line
+
+
+def test_metrics_sink_records_events_and_status():
+    sink = M.MetricsSink()
+    sink.query_started("q1", app="app-x")  # _ev events carry app="app-x"
+    st = sink.status_snapshot()
+    assert st["query"]["query"] == "q1" and st["query"]["attempt"] == 1
+    assert st["query"]["elapsed_ms"] >= 0
+    sink.record(_ev("ladder_rung", query="q1", rung="recover_retry",
+                    failure_kind=faults.DEVICE_OOM))
+    sink.record(_ev("heartbeat", query="q1", elapsed_ms=40.0,
+                    rss_bytes=2048))
+    st = sink.status_snapshot()
+    assert st["query"]["attempt"] == 2
+    assert st["query"]["ladder"] == ["recover_retry"]
+    assert st["rss_bytes"] == 2048
+    assert st["heartbeat_age_ms"] is not None
+    sink.record(_ev("query_span", query="q1", dur_ms=55.0,
+                    status="Completed", retries=1, mem_hw_bytes=9000,
+                    mem_source="rss"))
+    sink.record(_ev("query_span", query="q2", dur_ms=5.0, status="Failed",
+                    retries=0, failure_kind=faults.TIMEOUT))
+    sink.record(_ev("exec_cache", pipeline="p", bucket=1024, hit=True))
+    sink.record(_ev("exec_cache", pipeline="p", bucket=1024, hit=False))
+    sink.record(_ev("phase", phase="power_test", event="begin", index=4,
+                    total=8))
+    st = sink.status_snapshot()
+    assert st["query"] is None  # q1's span retired the in-flight record
+    assert st["queries_completed"] == 1 and st["queries_failed"] == 1
+    assert st["mem_hw_bytes"] == 9000 and st["mem_source"] == "rss"
+    assert st["caches"]["exec_cache"] == {"hits": 1, "total": 2, "rate": 0.5}
+    assert st["phase"]["name"] == "power_test" and st["phase"]["index"] == 4
+    sink.record(_ev("phase", phase="power_test", event="end", status="ok"))
+    st = sink.status_snapshot()
+    assert st["phase"] is None
+    assert st["last_phase"] == {"name": "power_test", "status": "ok"}
+    reg = sink.registry
+    assert reg.counter_value("nds_query_span_total", status="Completed") == 1
+    assert reg.counter_value("nds_query_span_total", status="Failed") == 1
+    assert M.validate_exposition(reg.render()) == []
+
+
+def test_metrics_sink_in_flight_keyed_per_stream():
+    """Thread-mode throughput: two streams running the SAME query name
+    concurrently must keep independent in-flight records — one stream's
+    finish must not retire (or its rungs mutate) the other's."""
+    sink = M.MetricsSink()
+    sink.query_started("query5", app="stream-a")
+    sink.query_started("query5", app="stream-b")
+    sink.record(_ev("ladder_rung", app="stream-b", query="query5",
+                    rung="recover_retry", failure_kind=faults.DEVICE_OOM))
+    sink.record(_ev("query_span", app="stream-a", query="query5",
+                    dur_ms=10.0, status="Completed", retries=0))
+    st = sink.status_snapshot()
+    assert len(st["in_flight"]) == 1  # only stream-b's run still lives
+    assert st["in_flight"][0]["app"] == "stream-b"
+    assert st["in_flight"][0]["attempt"] == 2  # b's rung stayed with b
+    sink.record(_ev("query_span", app="stream-b", query="query5",
+                    dur_ms=20.0, status="Completed", retries=1))
+    assert sink.status_snapshot()["in_flight"] == []
+
+
+def test_metrics_sink_never_raises_on_garbage():
+    sink = M.MetricsSink()
+    sink.record({"kind": "query_span"})  # all fields missing
+    sink.record({"kind": "no_such_kind"})
+    sink.record({})
+    assert sink.status_snapshot()["queries_completed"] == 1  # status=None != Failed
+
+
+def test_metrics_server_endpoints():
+    from nds_tpu.obs.httpserv import MetricsServer
+
+    sink = M.MetricsSink()
+    sink.record(_ev("plan_cache", node="Aggregate", hit=True))
+    server = MetricsServer(sink, port=0, host="127.0.0.1").start()
+    try:
+        body = _scrape(server.port, "/metrics")
+        assert M.validate_exposition(body) == []
+        assert 'nds_plan_cache_total{result="hit"} 1' in body
+        st = json.loads(_scrape(server.port, "/statusz"))
+        assert st["caches"]["plan_cache"]["hits"] == 1
+        assert _scrape(server.port, "/healthz").strip() == "ok"
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError):
+            _scrape(server.port, "/nope")
+    finally:
+        server.stop()
+
+
+def test_session_metrics_without_trace_dir(monkeypatch, tmp_path):
+    """The live-telemetry-only mode: NDS_METRICS_PORT set, no trace dir —
+    the session gets a sink-only tracer (no file, no in-memory growth) and
+    the shared endpoint serves live counters for its queries."""
+    monkeypatch.setenv("NDS_METRICS_PORT", "0")
+    s = Session()
+    assert s.metrics is not None
+    assert s.tracer is not None
+    assert s.tracer.path is None and s.tracer.events is None
+    s.register_arrow("t", pa.table({"a": [1, 2, 3], "b": [10, 20, 30]}))
+    with bind(s.tracer):
+        summary = BenchReport(s).report_on(
+            lambda: s.sql("select a, sum(b) sb from t group by a").collect(),
+            name="q_live",
+        )
+    assert summary["queryStatus"] == ["Completed"]
+    server = M.active_server()
+    assert server is not None
+    body = _scrape(server.port, "/metrics")
+    assert M.validate_exposition(body) == []
+    assert 'nds_query_span_total{status="Completed"} 1' in body
+    assert "nds_op_span_total" in body
+    st = json.loads(_scrape(server.port, "/statusz"))
+    assert st["queries_completed"] == 1
+    # a second session in the same process reuses the shared sink/server
+    s2 = Session()
+    assert s2.metrics is s.metrics
+    assert M.active_server() is server
+
+
+def test_metrics_disabled_is_zero_cost(monkeypatch):
+    monkeypatch.delenv("NDS_METRICS_PORT", raising=False)
+    assert M.resolve_metrics_port({}) is None
+    assert M.maybe_serve({}) is None
+    assert tracer_from_conf({}) is None
+    s = Session()
+    assert s.metrics is None and s.tracer is None
+
+
+def test_traced_session_feeds_sink_and_file(monkeypatch, tmp_path):
+    """Trace dir AND metrics port: one tracer writes the event file and
+    feeds the live registry — the same events, two surfaces."""
+    monkeypatch.setenv("NDS_METRICS_PORT", "0")
+    s = _traced_session(tmp_path)
+    assert s.tracer.sink is s.metrics
+    with faults.scope("q_both"):
+        s.sql("select a, b from t").collect()
+    evs = _events(s.tracer.path)
+    n_cat = len([e for e in evs if e["kind"] == "catalog_load"])
+    assert n_cat >= 1
+    series = s.metrics.registry.counter_series("nds_catalog_load_total")
+    assert sum(series.values()) == n_cat
+
+
+def test_heartbeat_events_from_sampler(tmp_path, monkeypatch):
+    monkeypatch.setenv("NDS_HEARTBEAT_INTERVAL_MS", "20")
+    monkeypatch.setenv("NDS_TRACE_MEM_INTERVAL_MS", "5")
+    s = _traced_session(tmp_path)
+
+    def slow():
+        time.sleep(0.15)
+
+    BenchReport(s).report_on(slow, name="q_slow")
+    evs = _events(s.tracer.path)
+    assert R.validate_events(evs) == []
+    hbs = [e for e in evs if e["kind"] == "heartbeat"]
+    assert len(hbs) >= 2  # one immediate + periodic beats
+    assert all(e["query"] == "q_slow" for e in hbs)
+    assert hbs[-1]["elapsed_ms"] > hbs[0]["elapsed_ms"]
+    # rss present on Linux (the honest liveness signal for a hang)
+    assert hbs[-1]["rss_bytes"] is None or hbs[-1]["rss_bytes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# trace-dir rotation + compaction
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_rotates_segments_and_reader_reassembles(tmp_path):
+    tr = Tracer(str(tmp_path), app_id="rot", rotate_bytes=400)
+    for i in range(40):
+        tr.emit("plan_cache", node=f"n{i:03d}", hit=False)
+    tr.close()
+    files = R.discover_event_files(str(tmp_path))
+    assert len(files) > 2, "rotation must have produced segments"
+    assert [R.segment_key(f) for f in files] == sorted(
+        R.segment_key(f) for f in files
+    )
+    # segment 0 keeps the classic name; later segments carry the seq
+    assert os.path.basename(files[0]) == "events-rot.jsonl"
+    assert os.path.basename(files[1]) == "events-rot.0001.jsonl"
+    # every segment under the threshold + one line of slack
+    for f in files:
+        assert os.path.getsize(f) <= 400 + 200
+    # each segment opens with its own trace_meta (independently attributable)
+    for f in files:
+        first = next(R.iter_events(f, strict=True))
+        assert first["kind"] == "trace_meta" and first["app"] == "rot"
+    evs = R.read_events(str(tmp_path), strict=True)
+    assert R.validate_events(evs) == []
+    nodes = [e["node"] for e in evs if e["kind"] == "plan_cache"]
+    assert nodes == [f"n{i:03d}" for i in range(40)], (
+        "chain reassembly must preserve emission order"
+    )
+
+
+def test_reader_tolerates_torn_tail_of_non_final_segment(tmp_path):
+    """Satellite: torn-line classification is PER-SEGMENT. A torn final
+    line in a non-final rotated segment (crash evidence) must not
+    hard-error strict mode; mid-file corruption still must."""
+    _write_jsonl(
+        tmp_path / "events-app.jsonl",
+        [_ev("trace_meta", pid=1, version="0")],
+        torn_tail='{"ts": 3, "ki',
+    )
+    _write_jsonl(
+        tmp_path / "events-app.0001.jsonl",
+        [_ev("plan_cache", node="x", hit=True)],
+    )
+    evs = R.read_events(str(tmp_path), strict=True)
+    assert [e["kind"] for e in evs] == ["trace_meta", "plan_cache"]
+    # mid-file corruption in any segment is still a hard error
+    with open(tmp_path / "events-app.jsonl", "a") as f:
+        f.write("\n{broken}\n" + json.dumps(_ev("plan_cache", node="y",
+                                                hit=False)) + "\n")
+    with pytest.raises(R.MalformedEventError):
+        R.read_events(str(tmp_path), strict=True)
+
+
+def test_concurrent_emit_under_rotation(tmp_path):
+    """Satellite: N threads x M events through one rotating tracer — no
+    torn/interleaved lines, stable per-thread ordering, exact counts
+    after chain reassembly."""
+    n_threads, n_events = 8, 150
+    tr = Tracer(str(tmp_path), app_id="conc", rotate_bytes=2000)
+
+    def worker(t):
+        for i in range(n_events):
+            tr.emit("plan_cache", node=f"t{t}:{i:04d}", hit=True)
+
+    threads = [
+        threading.Thread(target=worker, args=(t,)) for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    tr.close()
+    files = R.discover_event_files(str(tmp_path))
+    assert len(files) > 2
+    evs = R.read_events(str(tmp_path), strict=True)  # no torn/mixed lines
+    assert R.validate_events(evs) == []
+    pc = [e["node"] for e in evs if e["kind"] == "plan_cache"]
+    assert len(pc) == n_threads * n_events
+    for t in range(n_threads):
+        mine = [n for n in pc if n.startswith(f"t{t}:")]
+        assert mine == [f"t{t}:{i:04d}" for i in range(n_events)], (
+            f"thread {t}'s events must reassemble in emission order"
+        )
+
+
+def test_tracer_emit_after_close_is_noop(tmp_path, capsys):
+    """Satellite: a late emit after close() must not silently reopen the
+    file (the old handle leak) — it drops the event with ONE warning."""
+    tr = Tracer(str(tmp_path), app_id="late")
+    tr.emit("plan_cache", node="a", hit=True)
+    tr.close()
+    before = open(tr.path).read()
+    tr.emit("plan_cache", node="late1", hit=True)
+    tr.emit("plan_cache", node="late2", hit=True)
+    assert tr._fh is None, "post-close emit must not reopen the file"
+    assert open(tr.path).read() == before
+    out = capsys.readouterr().out
+    assert out.count("after close()") == 1  # one-shot, not per event
+    tr.close()  # idempotent
+
+
+def test_compact_trace_dir_folds_closed_segments(tmp_path):
+    tr = Tracer(str(tmp_path), app_id="cmp", rotate_bytes=500)
+    for i in range(30):
+        tr.emit("op_span", exec_id=1, seq=i + 1, depth=0, node="Scan",
+                explain="Scan t", dur_ms=2.0, rows=10, est_bytes=80,
+                query="q1")
+    tr.emit("query_span", query="q1", dur_ms=99.0, status="Completed",
+            retries=0)
+    tr.close()
+    before = R.load_profile(str(tmp_path))
+    n_seg = len(R.discover_event_files(str(tmp_path)))
+    assert n_seg > 2
+    folded, skipped = R.compact_trace_dir(str(tmp_path))
+    assert skipped == []
+    assert len(folded) == 1 and len(folded[0][1]) == n_seg - 1
+    remaining = R.discover_event_files(str(tmp_path))
+    assert len(remaining) == 1  # only the open tail keeps raw spans
+    assert R.discover_compact_files(str(tmp_path))
+    # disk now bounded: raw spans <= one segment (the rotate threshold)
+    raw = sum(os.path.getsize(f) for f in remaining)
+    assert raw <= 500 + 200
+    after = R.load_profile(str(tmp_path))
+    assert after["tallies"] == before["tallies"]
+    assert after["queries"]["q1"]["wall_ms"] == before["queries"]["q1"]["wall_ms"]
+    assert after["queries"]["q1"]["status"] == "Completed"
+    ops_b = before["queries"]["q1"]["ops"]["Scan"]
+    ops_a = after["queries"]["q1"]["ops"]["Scan"]
+    assert ops_a["count"] == ops_b["count"] == 30
+    assert ops_a["incl_ms"] == pytest.approx(ops_b["incl_ms"])
+    assert ops_a["rows"] == ops_b["rows"]
+    # a second round folds the chain's remaining tail segment and MERGES
+    # into the existing artifact (one artifact per app, accumulating)
+    folded2, _ = R.compact_trace_dir(str(tmp_path), fold_open=True)
+    assert folded2 and len(R.discover_compact_files(str(tmp_path))) == 1
+    assert R.discover_event_files(str(tmp_path)) == []
+    final = R.load_profile(str(tmp_path))
+    assert final["queries"]["q1"]["ops"]["Scan"]["count"] == 30
+    assert final["tallies"] == before["tallies"]
+    # a later tracer (fresh app id, as default_app_id guarantees) adds its
+    # own chain; the dir profile sums across both apps' artifacts
+    tr2 = Tracer(str(tmp_path), app_id="cmp2", rotate_bytes=500)
+    for i in range(30):
+        tr2.emit("op_span", exec_id=2, seq=i + 1, depth=0, node="Scan",
+                 explain="Scan t", dur_ms=2.0, rows=10, est_bytes=80,
+                 query="q1")
+    tr2.close()
+    R.compact_trace_dir(str(tmp_path), fold_open=True)
+    assert R.discover_event_files(str(tmp_path)) == []
+    assert final["queries"]["q1"]["ops"]["Scan"]["count"] == 30
+    total = R.load_profile(str(tmp_path))
+    assert total["queries"]["q1"]["ops"]["Scan"]["count"] == 60
+
+
+def test_compact_crash_between_write_and_delete_never_double_counts(
+    tmp_path,
+):
+    """The artifact commits before the raw deletes; a crash in between
+    leaves folded segments on disk. The next run must recognize them via
+    the artifact's `segments` provenance and finish the delete WITHOUT
+    re-merging (and the half-compacted dir must not profile double)."""
+    tr = Tracer(str(tmp_path), app_id="crash", rotate_bytes=400)
+    for i in range(20):
+        tr.emit("plan_cache", node=f"n{i}", hit=True)
+    tr.close()
+    before = R.load_profile(str(tmp_path))
+    folded, _ = R.compact_trace_dir(str(tmp_path), fold_open=True)
+    deleted = folded[0][1]
+    # simulate the crash: resurrect the folded raw segments post-artifact
+    for i, f in enumerate(deleted):
+        _write_jsonl(f, [_ev("plan_cache", app="crash", node=f"n{i}",
+                             hit=True)])
+    # even the half-compacted state profiles ONCE (load_profile drops raw
+    # segments named in an artifact's provenance before aggregating)
+    half = R.load_profile(str(tmp_path))
+    assert half["tallies"]["plan_cache_hits"] == \
+        before["tallies"]["plan_cache_hits"]
+    folded2, skipped2 = R.compact_trace_dir(str(tmp_path), fold_open=True)
+    assert skipped2 == []
+    assert sorted(folded2[0][1]) == sorted(deleted)  # delete finished
+    assert R.discover_event_files(str(tmp_path)) == []
+    after = R.load_profile(str(tmp_path))
+    assert after["tallies"]["plan_cache_hits"] == \
+        before["tallies"]["plan_cache_hits"] == 20
+
+
+def test_compact_leaves_corrupt_segments_in_place(tmp_path):
+    _write_jsonl(tmp_path / "events-bad.jsonl",
+                 [_ev("plan_cache", node="a", hit=True)])
+    with open(tmp_path / "events-bad.jsonl", "a") as f:
+        f.write("{broken}\n")
+        f.write(json.dumps(_ev("plan_cache", node="b", hit=True)) + "\n")
+    _write_jsonl(tmp_path / "events-bad.0001.jsonl",
+                 [_ev("plan_cache", node="c", hit=True)])
+    folded, skipped = R.compact_trace_dir(str(tmp_path), fold_open=True)
+    assert len(skipped) == 1 and "events-bad.jsonl" in skipped[0][0]
+    assert os.path.exists(tmp_path / "events-bad.jsonl"), (
+        "compaction must never delete evidence it could not read"
+    )
+    assert not os.path.exists(tmp_path / "events-bad.0001.jsonl")
+
+
+def test_compact_refuses_schema_dirty_segments(tmp_path):
+    """`profile --check` must keep its teeth over compacted dirs: a
+    segment with schema-breaking events is never absorbed into an
+    artifact — it stays raw (where --check flags it) and is reported."""
+    _write_jsonl(tmp_path / "events-dirty.jsonl",
+                 [_ev("op_span", query="q")])  # missing required fields
+    _write_jsonl(tmp_path / "events-dirty.0001.jsonl",
+                 [_ev("plan_cache", node="x", hit=True)])
+    folded, skipped = R.compact_trace_dir(str(tmp_path), fold_open=True)
+    assert len(skipped) == 1 and "schema" in skipped[0][1]
+    assert os.path.exists(tmp_path / "events-dirty.jsonl")
+    assert not os.path.exists(tmp_path / "events-dirty.0001.jsonl")
+    with pytest.raises(SystemExit) as exc:
+        profile_cli.main([str(tmp_path), "--check"])
+    assert exc.value.code == 2
+
+
+def test_compact_and_profile_reject_structurally_bad_artifact(tmp_path):
+    """An artifact with "profile": null (torn/hand-edited) must fail the
+    ValueError path everywhere — never an AttributeError inside merge."""
+    (tmp_path / "compact-app.json").write_text(
+        json.dumps({"compact": 1, "app": "app", "segments": [],
+                    "events": 0, "profile": None})
+    )
+    with pytest.raises(ValueError):
+        R.read_compact(str(tmp_path / "compact-app.json"))
+    _write_jsonl(tmp_path / "events-app.jsonl",
+                 [_ev("plan_cache", node="a", hit=True)])
+    folded, skipped = R.compact_trace_dir(str(tmp_path), fold_open=True)
+    assert folded == [] and len(skipped) == 1  # chain skipped, not crashed
+    with pytest.raises(SystemExit) as exc:  # CLI: exit 2, not a traceback
+        profile_cli.main([str(tmp_path)])
+    assert exc.value.code == 2
+    # nested damage is caught too (profile.queries value not a mapping)
+    (tmp_path / "compact-app.json").write_text(
+        json.dumps({"compact": 1, "app": "app", "segments": [],
+                    "events": 0, "profile": {"queries": {"q1": "junk"}}})
+    )
+    with pytest.raises(ValueError):
+        R.read_compact(str(tmp_path / "compact-app.json"))
+
+
+def test_profile_mem_source_tracks_high_water_through_compaction(tmp_path):
+    """mem_source must describe the run HOLDING the high-water, and a
+    compacted dir must agree with the raw profile on it."""
+    tr = Tracer(str(tmp_path), app_id="mem", rotate_bytes=250)
+    tr.emit("query_span", query="q1", dur_ms=1.0, status="Completed",
+            retries=0, mem_hw_bytes=9000, mem_source="device")
+    tr.emit("query_span", query="q1", dur_ms=1.0, status="Completed",
+            retries=0, mem_hw_bytes=5000, mem_source="rss")
+    tr.close()
+    raw = R.load_profile(str(tmp_path))
+    assert raw["queries"]["q1"]["mem_hw_bytes"] == 9000
+    assert raw["queries"]["q1"]["mem_source"] == "device"
+    R.compact_trace_dir(str(tmp_path), fold_open=True)
+    compacted = R.load_profile(str(tmp_path))
+    assert compacted["queries"]["q1"]["mem_hw_bytes"] == 9000
+    assert compacted["queries"]["q1"]["mem_source"] == "device"
+
+
+def test_compact_skips_chain_with_corrupt_prior_artifact(tmp_path, capsys):
+    (tmp_path / "compact-app.json").write_text("{truncated")
+    _write_jsonl(tmp_path / "events-app.jsonl",
+                 [_ev("plan_cache", node="a", hit=True)])
+    _write_jsonl(tmp_path / "events-other.jsonl",
+                 [_ev("plan_cache", node="b", hit=True)])
+    folded, skipped = R.compact_trace_dir(str(tmp_path), fold_open=True)
+    # the bad artifact's chain is skipped (nothing overwritten/deleted)...
+    assert len(skipped) == 1 and "compact-app.json" in skipped[0][0]
+    assert os.path.exists(tmp_path / "events-app.jsonl")
+    # ...while the other app's chain still folds
+    assert [app for app, _ in folded] == ["other"]
+    assert not os.path.exists(tmp_path / "events-other.jsonl")
+    # and the CLI reports + exits nonzero instead of dying with a traceback
+    with pytest.raises(SystemExit) as exc:
+        profile_cli.main(["compact", str(tmp_path), "--all"])
+    assert exc.value.code == 1
+
+
+def test_profile_cli_compact_subcommand(tmp_path, capsys):
+    tr = Tracer(str(tmp_path), app_id="cli", rotate_bytes=300)
+    for i in range(25):
+        tr.emit("plan_cache", node=f"n{i}", hit=True)
+    tr.close()
+    profile_cli.main(["compact", str(tmp_path), "--dry_run"])
+    out = capsys.readouterr().out
+    assert "would fold" in out
+    assert len(R.discover_compact_files(str(tmp_path))) == 0
+    profile_cli.main(["compact", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "folded" in out
+    assert len(R.discover_compact_files(str(tmp_path))) == 1
+    # the profiler renders a compacted dir transparently
+    profile_cli.main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "plan-cache 25 hit" in out
+
+
+# ---------------------------------------------------------------------------
 # end-to-end: a traced power run over real (tiny) data + the profiler CLI
 # ---------------------------------------------------------------------------
 
@@ -674,3 +1194,114 @@ def test_traced_power_run_end_to_end(data_dir, tmp_path, monkeypatch, capsys):
     assert "tallies" in out
     # the budgeter's statement verdicts surface in the profile summary
     assert "plan budget" in out and "direct" in out
+
+
+@pytest.mark.slow
+def test_live_telemetry_power_run_end_to_end(data_dir, tmp_path, monkeypatch,
+                                             capsys):
+    """Acceptance (ISSUE 8): with NDS_METRICS_PORT set, a mid-flight power
+    run answers /statusz with the currently executing query and /metrics
+    with monotonically increasing query_span/exec_cache counters in valid
+    exposition format; the tracer rotates segments at the configured byte
+    cap; `profile compact` then bounds the raw-span disk while the
+    profile over the compacted dir equals the uncompacted one for the
+    summary fields."""
+    from nds_tpu.power import gen_sql_from_stream, run_query_stream
+
+    trace_dir = tmp_path / "trace"
+    monkeypatch.setenv("NDS_TRACE_DIR", str(trace_dir))
+    monkeypatch.setenv("NDS_METRICS_PORT", "0")  # ephemeral bind
+    rotate = 8000
+    monkeypatch.setenv("NDS_TRACE_ROTATE_BYTES", str(rotate))
+    monkeypatch.setenv("NDS_HEARTBEAT_INTERVAL_MS", "50")
+    stream = tmp_path / "query_0.sql"
+    stream.write_text(STREAM)
+    snaps = {"statusz": [], "metrics": [], "errors": []}
+    stop = threading.Event()
+
+    def scraper():
+        while not stop.is_set():
+            server = M.active_server()
+            if server is None:
+                time.sleep(0.002)
+                continue
+            try:
+                st = json.loads(_scrape(server.port, "/statusz"))
+                body = _scrape(server.port, "/metrics")
+            except Exception:
+                time.sleep(0.002)
+                continue
+            snaps["errors"].extend(M.validate_exposition(body))
+            snaps["statusz"].append(st)
+            snaps["metrics"].append(body)
+            time.sleep(0.002)
+
+    t = threading.Thread(target=scraper, daemon=True)
+    t.start()
+    try:
+        run_query_stream(
+            input_prefix=data_dir,
+            property_file=None,
+            query_dict=gen_sql_from_stream(str(stream)),
+            time_log_output_path=str(tmp_path / "time.csv"),
+            input_format="csv",
+        )
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    # -- live surface: scraped mid-run, well-formed, monotone ------------
+    assert snaps["errors"] == []
+    assert snaps["metrics"], "the endpoint must have answered mid-run"
+    in_flight = [
+        s["query"]["query"] for s in snaps["statusz"] if s.get("query")
+    ]
+    assert in_flight, "/statusz must have named an executing query mid-run"
+    assert set(in_flight) <= {"query96", "query3", "query42", "query55"}
+
+    def counter_total(body, family):
+        total = 0.0
+        for line in body.splitlines():
+            if line.startswith("#"):
+                continue
+            name = line.split("{", 1)[0].split(" ", 1)[0]
+            if name == family:
+                total += float(line.rsplit(" ", 1)[1])
+        return total
+
+    qs = [counter_total(b, "nds_query_span_total") for b in snaps["metrics"]]
+    ec = [counter_total(b, "nds_exec_cache_total") for b in snaps["metrics"]]
+    assert qs == sorted(qs) and ec == sorted(ec), "counters must be monotone"
+    sink = M.shared_sink()
+    assert sum(
+        sink.registry.counter_series("nds_query_span_total").values()
+    ) == 4
+    assert sum(
+        sink.registry.counter_series("nds_exec_cache_total").values()
+    ) >= 1
+    assert sum(
+        sink.registry.counter_series("nds_heartbeat_total").values()
+    ) >= 4  # at least one beacon per query
+    # -- rotation + compaction bound the trace dir -----------------------
+    files = R.discover_event_files(str(trace_dir))
+    assert len(files) >= 2, "the run must have rotated at the byte cap"
+    evs = R.read_events(str(trace_dir), strict=True)
+    assert R.validate_events(evs) == []
+    assert any(e["kind"] == "heartbeat" for e in evs)
+    before = R.load_profile(str(trace_dir))
+    profile_cli.main(["compact", str(trace_dir)])
+    capsys.readouterr()
+    raw = sum(
+        os.path.getsize(f) for f in R.discover_event_files(str(trace_dir))
+    )
+    assert raw <= rotate + 2048, "compacted raw spans must stay under the cap"
+    after = R.load_profile(str(trace_dir))
+    assert set(after["queries"]) == set(before["queries"])
+    for q, rec in before["queries"].items():
+        assert after["queries"][q]["status"] == rec["status"] == "Completed"
+        assert after["queries"][q]["runs"] == rec["runs"]
+        assert after["queries"][q]["wall_ms"] == pytest.approx(rec["wall_ms"])
+    assert after["tallies"] == before["tallies"]
+    # the profiler CLI re-profiles the compacted dir, schema-checked
+    profile_cli.main([str(trace_dir), "--check"])
+    out = capsys.readouterr().out
+    assert "query42" in out and "tallies" in out
