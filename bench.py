@@ -291,6 +291,14 @@ def bench_geomean(sess, block=None, scale=None, wall_budget=None):
                     getattr(sess, "last_blocked_union", None) is not None
                 ):
                     box["blocked"] = True
+                # out-of-core marker (same contract): a statement that
+                # routed through the spill paths gets the same OOM-bail
+                # exemption — its OOM is a per-query error, not backend
+                # poisoning evidence
+                if getattr(ex, "last_spill", None) is not None or (
+                    getattr(sess, "last_spill", None) is not None
+                ):
+                    box["spilled"] = True
                 return err
 
             from nds_tpu import faults
@@ -327,6 +335,8 @@ def bench_geomean(sess, block=None, scale=None, wall_budget=None):
         # see it when the exception below is raised
         if meta is not None and box.get("blocked"):
             meta["blocked"] = True
+        if meta is not None and box.get("spilled"):
+            meta["spilled"] = True
         if wedged:
             return "wedged"
         if "exc" in box:  # real failures beat the timeout label
@@ -342,7 +352,16 @@ def bench_geomean(sess, block=None, scale=None, wall_budget=None):
     def update_out():
         _fill_block(block, detail, failed, wall_start)
         dbucket["per_query"] = {
-            n: {"cold": round(v["cold"], 2), "steady": round(v["steady"], 3)}
+            n: {
+                "cold": round(v["cold"], 2),
+                "steady": round(v["steady"], 3),
+                **({"spill": v["spill"]} if "spill" in v else {}),
+                **(
+                    {"budget_verdict": v["budget_verdict"]}
+                    if "budget_verdict" in v
+                    else {}
+                ),
+            }
             for n, v in detail.items()
         }
         if failed:
@@ -374,6 +393,7 @@ def bench_geomean(sess, block=None, scale=None, wall_budget=None):
             emit()
             break
         sess.last_blocked_union = None  # set by blocked union-agg execution
+        sess.last_spill = None  # set by out-of-core (spilled) execution
         meta = {}  # run_with_timeout sets meta["blocked"] when it routed
         try:
             t0 = time.perf_counter()
@@ -391,6 +411,18 @@ def bench_geomean(sess, block=None, scale=None, wall_budget=None):
                     detail[name] = {
                         "cold": cold, "steady": time.perf_counter() - t0,
                     }
+                    # per-query out-of-core evidence (ISSUE 9 acceptance):
+                    # the spill stats + static budget verdict ride the
+                    # bench detail so SF10 isolation output shows WHY a
+                    # query completed degraded
+                    spill_rec = getattr(sess, "last_spill", None)
+                    if spill_rec:
+                        detail[name]["spill"] = dict(spill_rec)
+                    budget_rec = getattr(sess, "last_plan_budget", None)
+                    if isinstance(budget_rec, dict) and budget_rec.get(
+                        "verdict"
+                    ):
+                        detail[name]["budget_verdict"] = budget_rec["verdict"]
                 finally:
                     sess.conf["engine.plan_cache"] = "on"
             if status == "ok":
@@ -428,7 +460,7 @@ def bench_geomean(sess, block=None, scale=None, wall_budget=None):
                 # recover_memory), so three of those in a row means every
                 # further query would burn the run budget failing the same
                 # way.
-                if not meta.get("blocked"):
+                if not meta.get("blocked") and not meta.get("spilled"):
                     if os.environ.get("NDS_BENCH_OOM_EXIT"):
                         # SF10 isolation child: a hard OOM on an unblocked
                         # plan permanently poisons this backend, so exit

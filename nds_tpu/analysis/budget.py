@@ -199,7 +199,40 @@ CALIBRATION_SLACK = 2.0
 #: Session.union_agg_window_rows used to carry inline
 WINDOW_BUDGET_FRACTION = 16
 
+#: out-of-core partition-count cap: past this, per-partition fixed costs
+#: (probe re-scan, segment round trips) dominate any HBM relief
+SPILL_MAX_PARTITIONS = 256
+
 MODES = ("off", "warn", "on")
+
+
+def spillable_node(v) -> bool:
+    """True when a plan node owns an out-of-core rewrite the executor can
+    actually run (exec._spilled_join/_spilled_take/_spilled_distinct):
+    inner/left joins and MultiJoins (hash-partitioned build+probe), sorts
+    (sorted runs), Distinct and UNION-distinct (partition-hash dedup).
+    Everything else — semi/anti/full joins, set ops with whole-input
+    semantics, aggregates (the blocked-union seam owns those) — does not
+    decompose over hash partitions, and the verifier flags a
+    `spill_partitions` annotation landing on one."""
+    if isinstance(v, P.Join):
+        return v.kind in ("inner", "left")
+    if isinstance(v, (P.MultiJoin, P.Sort, P.Distinct)):
+        return True
+    if isinstance(v, P.SetOp):
+        return v.op == "union"
+    return False
+
+
+def choose_spill_partitions(peak_bytes: int, budget_bytes: int) -> int:
+    """Statically sized partition count: the smallest power of two that
+    models the dominant transient under the budget, clamped to
+    [2, SPILL_MAX_PARTITIONS]."""
+    ratio = max(
+        -(-int(peak_bytes) // max(int(budget_bytes), 1)), 2
+    )  # ceil div
+    parts = 1 << (ratio - 1).bit_length()
+    return int(min(max(parts, 2), SPILL_MAX_PARTITIONS))
 
 #: TPC-DS column-name prefix -> owning table (longest match wins). A
 #: column cannot carry more distinct values than its owning table has
@@ -456,9 +489,14 @@ class PlanBudget:
     peak_bytes: int  # modeled peak, blocked-union aggregates DIRECT
     peak_blocked_bytes: int  # modeled peak with blocked aggs windowed
     budget_bytes: int
-    verdict: str  # direct | blocked | reject | unknown
+    verdict: str  # direct | blocked | spill | over | reject | unknown
     window_rows: Optional[int] = None  # set when verdict == blocked
     unknown_tables: list = field(default_factory=list)
+    #: the plan carries >= 1 out-of-core seam (spillable_node) — recorded
+    #: for EVERY verdict so the report ladder's spill_retry rung knows an
+    #: unpredicted device OOM can retry through the spill pool
+    spillable: bool = False
+    spill_partitions: Optional[int] = None  # set when verdict == spill
 
     def table(self, limit: int = 0) -> str:
         """Human-readable per-node estimate table (explain --budget)."""
@@ -479,6 +517,11 @@ class PlanBudget:
             f" (windowed={_fmt_bytes(self.peak_blocked_bytes)})"
             f" budget={_fmt_bytes(self.budget_bytes)}"
             + (f" window_rows={self.window_rows}" if self.window_rows else "")
+            + (
+                f" spill_partitions={self.spill_partitions}"
+                if self.spill_partitions
+                else ""
+            )
             + (
                 f" unknown_tables={sorted(set(self.unknown_tables))}"
                 if self.unknown_tables
@@ -894,6 +937,12 @@ def analyze_plan(
         peak_blocked = min(win.run(plan), peak)
         if win.blocked_windows:
             window_rows = min(win.blocked_windows)
+    spillable = any(
+        spillable_node(v)
+        for v in P.walk_plan(plan)
+        if isinstance(v, P.PlanNode)
+    )
+    spill_partitions = None
     if direct.unknown_tables:
         verdict = "unknown"
         window_rows = None
@@ -903,7 +952,20 @@ def analyze_plan(
     elif has_blocked and peak_blocked <= budget:
         verdict = "blocked"
     elif min(peak_blocked, peak) <= reject_line:
-        verdict = "over"
+        # admitted over budget. With an out-of-core seam the verdict is
+        # `spill` (between `over` and `reject`): the overage partitions
+        # away through the executor's spilled join/sort/distinct paths,
+        # with the partition count chosen statically here so the first
+        # attempt already runs out-of-core instead of discovering the
+        # misfit as a device OOM. Seamless plans stay `over` — admitted
+        # with the ladder's prediction armed, exactly as before.
+        if spillable:
+            verdict = "spill"
+            spill_partitions = choose_spill_partitions(
+                min(peak_blocked, peak), budget
+            )
+        else:
+            verdict = "over"
         window_rows = window_rows if has_blocked else None
     else:
         verdict = "reject"
@@ -916,6 +978,8 @@ def analyze_plan(
         verdict=verdict,
         window_rows=window_rows,
         unknown_tables=list(direct.unknown_tables),
+        spillable=spillable,
+        spill_partitions=spill_partitions,
     )
 
 
@@ -932,6 +996,7 @@ def emit_budget_event(tracer, pb: PlanBudget) -> None:
         budget_bytes=pb.budget_bytes,
         peak_blocked_bytes=pb.peak_blocked_bytes,
         window_rows=pb.window_rows,
+        spill_partitions=pb.spill_partitions,
         nodes=len(pb.nodes),
     )
 
@@ -978,7 +1043,11 @@ def budget_plan(plan: P.PlanNode, session) -> Optional[PlanBudget]:
     annotate = (
         mode == "on"
         and pb.window_rows is not None
-        and pb.verdict in ("blocked", "over")
+        # `spill` included: a plan whose blocked seam is insufficient on
+        # its own still runs its blocked aggregates with the static
+        # window (the spill annotations below handle the rest) — exactly
+        # the window an `over` verdict would have armed pre-spill
+        and pb.verdict in ("blocked", "spill", "over")
     )
     # an explicit conf/env window eclipses the annotation at execution
     # time (Session.union_agg_window_rows resolution order), so the
@@ -994,9 +1063,19 @@ def budget_plan(plan: P.PlanNode, session) -> Optional[PlanBudget]:
         "budget_bytes": pb.budget_bytes,
         "window_rows": pb.window_rows,
         "annotated": annotate and not explicit,
+        # spill_retry arming: recorded for EVERY verdict — an unpredicted
+        # device OOM on a direct/over-verdict plan with an out-of-core
+        # seam still retries through the pool (report._next_rung)
+        "spillable": pb.spillable,
+        "spill_partitions": pb.spill_partitions,
     }
     if annotate:
         _annotate_blocked_windows(plan, pb.window_rows)
+    if mode == "on" and pb.verdict == "spill" and pb.spill_partitions:
+        # statically planned degradation: the executor's auto mode spills
+        # exactly these nodes (warn stays observe-only, like the window
+        # annotation above)
+        _annotate_spill(plan, pb.spill_partitions)
     if pb.verdict == "reject" and mode == "on":
         raise PlanBudgetError(
             pb.peak_bytes, pb.budget_bytes,
@@ -1013,3 +1092,14 @@ def _annotate_blocked_windows(plan: P.PlanNode, window_rows: int):
     for v in P.walk_plan(plan):
         if isinstance(v, P.Aggregate) and v.blocked_union:
             v.budget_window_rows = int(window_rows)
+
+
+def _annotate_spill(plan: P.PlanNode, partitions: int):
+    """Set `spill_partitions` (same dynamic-annotation family as
+    `budget_window_rows`: fingerprint/plan-cache-agnostic) on every
+    out-of-core-capable node — the executor's `auto` spill mode consumes
+    it (exec._spill_parts_for), and the verifier's annotation-coverage
+    rule checks its placement and sanity."""
+    for v in P.walk_plan(plan):
+        if isinstance(v, P.PlanNode) and spillable_node(v):
+            v.spill_partitions = int(partitions)

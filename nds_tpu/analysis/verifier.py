@@ -61,7 +61,12 @@ from ..engine import plan as P
 from ..engine.binder import _null_rejecting_shape
 from ..engine.expr import _lit_dtype, _promote
 from ..schema import TABLE_PARTITIONING
-from .budget import bucket_cap as _bucket_cap, schema_row_bytes
+from .budget import (
+    SPILL_MAX_PARTITIONS,
+    bucket_cap as _bucket_cap,
+    schema_row_bytes,
+    spillable_node,
+)
 
 # ---------------------------------------------------------------------------
 # PartitionSpec layout registry (ROADMAP item 1: sharding invariants are
@@ -221,6 +226,33 @@ class PlanVerifier:
                         "budget_window_rows set on a node that is not a "
                         "blocked-union Aggregate (the windowed executor "
                         "is the only consumer of static window sizing)",
+                    )
+            sp = getattr(n, "spill_partitions", None)
+            if sp is not None:
+                # out-of-core annotation coverage (registered ahead of the
+                # spilled-executor rewrite per the PR-5 contract): the
+                # annotation may only land on operators whose rewrite
+                # DECOMPOSES over hash partitions / sorted runs, and the
+                # statically chosen partition count must be sane
+                if not spillable_node(n):
+                    self._viol(
+                        "spill", n,
+                        "spill_partitions set on a node whose operator "
+                        "does not decompose over hash partitions/sorted "
+                        "runs (only inner/left joins, MultiJoins, Sorts, "
+                        "Distinct and UNION own the out-of-core rewrite)",
+                    )
+                elif not (
+                    isinstance(sp, int)
+                    and 2 <= sp <= SPILL_MAX_PARTITIONS
+                    and sp & (sp - 1) == 0
+                ):
+                    self._viol(
+                        "spill", n,
+                        f"spill_partitions={sp!r} is not a power of two "
+                        f"in [2, {SPILL_MAX_PARTITIONS}] (hash "
+                        f"partitioning and capacity buckets both need "
+                        f"pow2 alignment)",
                     )
 
     # ------------------------------------------------------------------

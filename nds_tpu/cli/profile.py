@@ -111,6 +111,14 @@ def _render_profile(prof, top: int, per_query: bool):
           f"watchdog fires {t['watchdog_fires']}; faults injected "
           f"{t['faults_injected']}; blocked-union windows "
           f"{t['blocked_union_windows']}")
+    # out-of-core evidence (spill events); .get() because compacted
+    # artifacts from pre-spill runs lack the keys
+    if t.get("spill_ops"):
+        print(f"== spill: {t['spill_ops']} out-of-core op(s); "
+              f"{_fmt_bytes(t.get('spill_bytes_in', 0))} into the host "
+              f"pool / {_fmt_bytes(t.get('spill_bytes_out', 0))} read "
+              f"back; {t.get('spill_evictions', 0)} segment(s) tiered "
+              f"to disk")
     pb = prof.get("plan_budget") or {}
     if pb.get("verdicts"):
         verdicts = ", ".join(

@@ -29,9 +29,11 @@ from ..ops import kernels as K
 from . import expr as E
 from . import fuse
 from . import plan as P
+from . import spill as SP
 from .columnar import (
     Column,
     Table,
+    _dyn_slice,
     bucket_cap,
     table_to_arrow,
     unify_dictionaries,
@@ -205,6 +207,9 @@ class Executor:
         self._fp_cache = {}  # id(plan) -> structural fingerprint
         # stats of the most recent blocked union-aggregation (tests/tools)
         self.last_blocked_union = None
+        # stats of this statement's out-of-core (spilled) operator
+        # executions, accumulated across ops (tests/bench evidence)
+        self.last_spill = None
         self._fault_checked = False  # exec-root injection fires once
         if tracer is None:
             tracer = getattr(
@@ -518,6 +523,16 @@ class Executor:
         if dist is not None:
             return dist
         order = K.sort_by_words(words)
+        parts = self._spill_parts_for(node)
+        if parts > 1:
+            # external sort: the SAME device sort order, but the output
+            # gather runs in bounded windows staged through the host spill
+            # pool (sorted runs) instead of materializing every column's
+            # full-capacity gather at once — results are bit-identical to
+            # the direct path because the permutation is identical
+            out = self._spilled_take(child, order, parts, op="sort")
+            if out is not None:
+                return out
         return self._take(child, order, child.nrows_lazy)
 
     def _sort_order_words(self, node: P.Sort, child: Table):
@@ -727,7 +742,9 @@ class Executor:
         child = self.execute(node.child)
         if child.nrows_known == 0:
             return child
-        return self._distinct_table(child)
+        return self._distinct_table(
+            child, spill_parts=self._spill_parts_for(node)
+        )
 
     # ------------------------------------------------------------------
     def _exec_setop(self, node: P.SetOp) -> Table:
@@ -736,7 +753,10 @@ class Executor:
         if node.op == "union_all":
             return self._concat(left, right)
         if node.op == "union":
-            return self._distinct_table(self._concat(left, right))
+            return self._distinct_table(
+                self._concat(left, right),
+                spill_parts=self._spill_parts_for(node),
+            )
         # intersect / except: set semantics over whole rows
         dl = self._distinct_table(left)
         names = list(dl.columns)
@@ -790,6 +810,7 @@ class Executor:
         return self._join(
             left, right, node.kind, node.left_keys, node.right_keys,
             node.residual, node.mark_name,
+            spill_parts=self._spill_parts_for(node),
         )
 
     def _exec_multijoin(self, node: P.MultiJoin) -> Table:
@@ -812,9 +833,13 @@ class Executor:
                 trace = session.join_order_cache.setdefault(
                     self._fp(node), {}
                 )
-        return self._multijoin_over_tables(tables, node.edges, trace=trace)
+        return self._multijoin_over_tables(
+            tables, node.edges, trace=trace,
+            spill_parts=self._spill_parts_for(node),
+        )
 
-    def _multijoin_over_tables(self, tables, edges, trace=None) -> Table:
+    def _multijoin_over_tables(self, tables, edges, trace=None,
+                               spill_parts=0) -> Table:
         """Greedy N-way inner join over already-executed relation tables
         (shared by _exec_multijoin and the blocked union-aggregation path,
         which re-joins each union window against the other relations).
@@ -837,7 +862,8 @@ class Executor:
 
         current = {i: tables[i] for i in range(n)}
 
-        return self._multijoin_greedy(current, edges, merged, group, n, trace)
+        return self._multijoin_greedy(current, edges, merged, group, n, trace,
+                                      spill_parts)
 
     def _execute_relations_batched(self, relations):
         """Execute a MultiJoin's relations and materialize their live
@@ -854,7 +880,8 @@ class Executor:
                 t._nrows = int(v)
         return tables
 
-    def _multijoin_greedy(self, current, edges, merged, group, n, trace=None):
+    def _multijoin_greedy(self, current, edges, merged, group, n, trace=None,
+                          spill_parts=0):
         # greedy: repeatedly take the connecting edge whose joined inputs are
         # smallest (sum of live rows), execute that join. When `trace`
         # carries recorded steps, replay them instead (identical relation
@@ -908,7 +935,10 @@ class Executor:
                 else:
                     rest.append((i, j, le, re_))
             edges = rest
-            joined = self._join(current[gi], current[gj], "inner", lkeys, rkeys, None)
+            joined = self._join(
+                current[gi], current[gj], "inner", lkeys, rkeys, None,
+                spill_parts=spill_parts,
+            )
             merged[gj] = gi
             current[gi] = joined
         if trace is not None and not replay:
@@ -932,14 +962,15 @@ class Executor:
         return t
 
     def _join(self, left, right, kind, left_keys, right_keys, residual,
-              mark_name=None):
+              mark_name=None, spill_parts=0):
         if kind == "cross":
             return self._cross_join(left, right)
         left = self._pack_sparse(left)
         right = self._pack_sparse(right)
         if kind == "right":
             # swap before any matching so the residual is preserved
-            return self._join(right, left, "left", right_keys, left_keys, residual)
+            return self._join(right, left, "left", right_keys, left_keys,
+                              residual, spill_parts=spill_parts)
         lev = self._evaluator(left)
         rev = self._evaluator(right)
         lcols = [lev.eval(e) for e in left_keys]
@@ -972,6 +1003,17 @@ class Executor:
         )
         if fast is not None:
             return fast
+        if spill_parts > 1 and kind in ("inner", "left"):
+            # out-of-core tier: the generic sort join's pair expansion +
+            # full-width pair-table gathers are THE additive-HBM shape of
+            # build-side-too-big joins; hash-partition both sides, join
+            # partition pairs one at a time (probe re-scanned per
+            # partition) and stage each partition's output in the host
+            # spill pool instead of accumulating it on device
+            return self._spilled_join(
+                left, right, kind, left_keys, right_keys, residual,
+                lk, lv, llive, rk, rv, rlive, spill_parts,
+            )
         li, ri, pl, total = K.join_candidates(lk, lv, llive, rk, rv, rlive)
         ok = K.verify_pairs(li, ri, pl, lk, lv, llive, rk, rv, rlive)
 
@@ -2781,8 +2823,12 @@ class Executor:
             )
         return Table(cols, nrows)
 
-    def _distinct_table(self, t: Table) -> Table:
+    def _distinct_table(self, t: Table, spill_parts=0) -> Table:
         t = self._pack_sparse(t)
+        if spill_parts > 1 and t.columns:
+            out = self._spilled_distinct(t, spill_parts)
+            if out is not None:
+                return out
         live = t.row_mask()
         words = self._group_words(list(t.columns.values()), live)
         order, gid, ng = K.group_by_words(words, live, t.nrows)
@@ -2790,6 +2836,186 @@ class Executor:
         first = K.segment_starts(gid, gcap)
         rows = order[jnp.clip(first, 0, t.cap - 1)]
         out = self._take(t, rows, ng)
+        out.unique_key = frozenset(out.columns)
+        return out
+
+    # -- out-of-core (spilled) execution --------------------------------
+    # The host-RAM spill pool tier (engine/spill.py): when a plan's peak
+    # materialization cannot fit HBM, the three remaining additive-capacity
+    # shapes — build-side-too-big hash joins, full-table sorts, whole-input
+    # distinct — run partitioned/windowed with intermediates staged in the
+    # budgeted host pool (disk-backed past its budget). Engagement:
+    # `engine.spill` off|auto|force — `auto` (default) spills exactly the
+    # nodes the static plan budgeter annotated with `spill_partitions`
+    # (verdict `spill`, analysis/budget.py); `force` (set by the report
+    # ladder's spill_retry rung after an unpredicted device OOM) routes
+    # every eligible node. Results are identical to the direct paths:
+    # the external sort reuses the direct path's exact permutation, and
+    # hash partitioning is value-exact for joins/distinct (SQL leaves
+    # their row order undefined; only the partition-major order differs).
+
+    #: partitions used under `engine.spill=force` when no explicit
+    #: `engine.spill_partitions` is set (the spill_retry rung sets one)
+    _SPILL_FORCE_PARTS = SP.DEFAULT_FORCE_PARTITIONS
+
+    def _spill_parts_for(self, node) -> int:
+        """Partition/run count for out-of-core execution of `node`, or 0
+        for the direct path. Annotation-driven in `auto` mode so unspilled
+        plans pay one getattr; `force` spills every eligible node."""
+        session = getattr(self.catalog, "session", None)
+        if session is None:
+            return 0
+        mode = str(session.conf.get("engine.spill", "auto")).lower()
+        if mode == "off":
+            return 0
+        if mode == "force":
+            try:
+                p = int(session.conf.get("engine.spill_partitions", 0) or 0)
+            except (TypeError, ValueError):
+                p = 0
+            return p if p > 1 else self._SPILL_FORCE_PARTS
+        try:
+            return int(getattr(node, "spill_partitions", 0) or 0)
+        except (TypeError, ValueError):
+            return 0
+
+    def _spill_finish(self, op, parts, pool, before, segments) -> Table:
+        """Assemble a spilled op's segments into one device table, record
+        the statement-level spill evidence (executor + session markers,
+        `spill` trace event) and release the segments."""
+        try:
+            out = SP.assemble_segments(pool, segments)
+        finally:
+            pool.release(segments)
+        delta = {
+            k: pool.stats[k] - before.get(k, 0)
+            for k in ("bytes_in", "bytes_out", "evictions")
+        }
+        note = self.last_spill or {
+            "ops": 0, "partitions": 0, "bytes_in": 0, "bytes_out": 0,
+            "evictions": 0,
+        }
+        note["ops"] += 1
+        note["partitions"] = max(note["partitions"], parts)
+        for k in ("bytes_in", "bytes_out", "evictions"):
+            note[k] += delta[k]
+        self.last_spill = note
+        session = getattr(self.catalog, "session", None)
+        if session is not None:
+            session.last_spill = note
+        if self.tracer is not None:
+            self.tracer.emit(
+                "spill", op=op, partitions=parts,
+                bytes_in=delta["bytes_in"], bytes_out=delta["bytes_out"],
+                evictions=delta["evictions"], rows=out.nrows_known,
+            )
+        return out
+
+    def _spilled_join(self, left, right, kind, left_keys, right_keys,
+                      residual, lk, lv, llive, rk, rv, rlive, parts) -> Table:
+        """Partitioned (Grace-style) hash join through the spill pool: both
+        sides hash-partition on the join key, each partition pair joins
+        with the regular engine paths (keys/residual re-evaluated over the
+        compacted partitions), and each partition's output spills to the
+        host pool so only one partition's pair table is ever live in HBM.
+        Exact: equal keys share a partition, so the union of per-partition
+        join results is the direct join result (null-keyed left rows land
+        in some partition, never match, and null-extend under LEFT —
+        exactly as the direct path treats them)."""
+        session = self.catalog.session
+        pool = session.spill_pool
+        before = dict(pool.stats)
+        lp = K.hash_columns(lk, lv) % parts
+        rp = K.hash_columns(rk, rv) % parts
+        segments = []
+        try:
+            for p in range(parts):
+                lpart = self._compact(left, (lp == p) & llive)
+                if lpart.nrows == 0 and segments:
+                    continue  # empty probe side: this partition is empty
+                rpart = self._compact(right, (rp == p) & rlive)
+                if kind == "inner" and rpart.nrows == 0 and segments:
+                    continue  # (LEFT must still null-extend its rows)
+                out = self._join(
+                    lpart, rpart, kind, left_keys, right_keys, residual
+                )
+                segments.append(pool.put(out))
+                session.spill_progress()
+            return self._spill_finish("join", parts, pool, before, segments)
+        except BaseException:
+            pool.release(segments)
+            raise
+
+    def _spilled_take(self, child: Table, order, parts, op="sort"):
+        """External sort tail: gather the sorted output in bounded windows
+        of the direct path's OWN permutation, staging each sorted run in
+        the host pool, then upload the assembled result once per column —
+        peak device transient is O(window x width) instead of every
+        column's full-capacity gather at once. Returns None when the input
+        is too small to window (callers fall through to the direct take).
+        Bit-identical to the direct path: same `order`, same row order."""
+        wcap = bucket_cap(max(child.cap // parts, 1))
+        if wcap >= child.cap:
+            return None
+        session = self.catalog.session
+        pool = session.spill_pool
+        before = dict(pool.stats)
+        nrows = child.nrows
+        segments = []
+        try:
+            for start in range(0, child.cap, wcap):
+                n_w = min(max(nrows - start, 0), wcap)
+                if n_w <= 0 and segments:
+                    break
+                idx = _dyn_slice(order, start, wcap)
+                cols = {
+                    name: Column(
+                        c.data[idx], c.dtype,
+                        None if c.valid is None else c.valid[idx],
+                        c.dictionary,
+                    )
+                    for name, c in child.columns.items()
+                }
+                segments.append(pool.put(Table(cols, n_w)))
+                session.spill_progress()
+            return self._spill_finish(op, parts, pool, before, segments)
+        except BaseException:
+            pool.release(segments)
+            raise
+
+    def _spilled_distinct(self, t: Table, parts):
+        """Spilling distinct: partition-hash dedup. Rows hash-partition
+        over ALL columns (valid flags folded in, so NULLs — which distinct
+        treats as equal — colocate), each partition dedups with the direct
+        sort-word machinery, and partition results stage in the host pool.
+        Exact as a row set: equal rows share a partition, partitions are
+        disjoint. Returns None for empty input (direct path handles it)."""
+        t = t.compacted()
+        if t.nrows == 0:
+            return None
+        session = self.catalog.session
+        pool = session.spill_pool
+        before = dict(pool.stats)
+        live = t.row_mask()
+        h = K.hash_columns(
+            [c.data for c in t.columns.values()],
+            [c.valid for c in t.columns.values()],
+        ) % parts
+        segments = []
+        try:
+            for p in range(parts):
+                part = self._compact(t, (h == p) & live)
+                if part.nrows == 0:
+                    if not segments:
+                        segments.append(pool.put(part))  # schema carrier
+                    continue
+                segments.append(pool.put(self._distinct_table(part)))
+                session.spill_progress()
+            out = self._spill_finish("distinct", parts, pool, before,
+                                     segments)
+        except BaseException:
+            pool.release(segments)
+            raise
         out.unique_key = frozenset(out.columns)
         return out
 

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import os
 import threading
-from time import perf_counter as _perf
+from time import monotonic as _monotonic, perf_counter as _perf
 from typing import Optional
 
 import pyarrow as pa
@@ -538,6 +538,51 @@ class Session:
         # RSS excursion that last fired the watermark is still above it,
         # so one crossing shrinks the window once, not once per query
         self._rss_above_watermark = False
+        # out-of-core tier (engine/spill.py): the host-RAM spill pool is
+        # built lazily on first spill; session start sweeps segment files a
+        # previous CRASHED process left in the spill dir (once per process
+        # per directory — the manifest/fingerprint-guarded orphan sweep)
+        from .spill import resolve_spill_dir, sweep_at_session_start
+
+        self._spill_pool = None
+        sweep_at_session_start(resolve_spill_dir(self.conf))
+        # marker (like last_blocked_union): stats of the most recent
+        # statement that routed through an out-of-core spill path; harness
+        # loops reset it per statement and read it as spill evidence
+        self.last_spill = None
+        # liveness beat of the most recent spill partition/run/merge phase
+        # (monotonic seconds): the report watchdog re-arms while a healthy
+        # out-of-core op keeps beating, so a long external sort is not
+        # misclassified as a hang (report.BenchReport._attempt)
+        self._progress_ts = None
+
+    @property
+    def spill_pool(self):
+        """The session's host-RAM spill pool (engine/spill.py), built on
+        first use. Knobs: `engine.spill_pool_bytes` / NDS_SPILL_POOL_BYTES
+        (host budget before segments tier to disk), `engine.spill_dir` /
+        NDS_SPILL_DIR (disk tier; empty string disables it)."""
+        if self._spill_pool is None:
+            from .spill import SpillPool, resolve_pool_bytes, resolve_spill_dir
+
+            with self.cache_lock:
+                if self._spill_pool is None:
+                    self._spill_pool = SpillPool(
+                        budget_bytes=resolve_pool_bytes(self.conf),
+                        spill_dir=resolve_spill_dir(self.conf),
+                        app_id=getattr(self.tracer, "app_id", None),
+                    )
+        return self._spill_pool
+
+    def spill_progress(self):
+        """Stamp out-of-core progress (called by the executor's spill paths
+        per partition/run): the per-query watchdog reads this to tell a
+        slow-but-alive external sort/merge from a genuine hang. The beat
+        carries the beating thread's identity so the watchdog only honors
+        beats from ITS OWN attempt's worker — an abandoned previous
+        attempt's zombie worker keeps beating on the shared session, and
+        those beats must not shield the next query's genuine hang."""
+        self._progress_ts = (threading.get_ident(), _monotonic())
 
     def _catalog_changed(self):
         """Any registration/drop/invalidation: cached plan results may now

@@ -86,6 +86,10 @@ _IO_PAT = (
     # error text, and XLA InternalError is deterministic, not transient
     "HTTP 503",
     "503 Service",
+    # spill-pool segment IO (engine/spill.py:SpillIOError): a failed
+    # host-tier write/read is storage flakiness, not a query bug — the
+    # ladder's io_backoff_retry rung owns it
+    "SpillIOError",
 )
 # PlanVerifyError: the static plan verifier (analysis/verifier.py) found a
 # structural invariant violation — deterministic, so the ladder fails fast.
@@ -301,12 +305,14 @@ def active() -> bool:
     return _registry is not None
 
 
-def maybe_fire(site: str):
+def maybe_fire(site: str, kinds=None):
     """Exact-match injection point. A single None check when no spec is
-    installed."""
+    installed. `kinds` restricts which rule kinds may fire here (the spill
+    pool's `spill:<site>` points accept io/crash only: an `oom:` rule is
+    about device allocation sites, not host-tier file IO)."""
     if _registry is None:
         return
-    _registry.fire(site)
+    _registry.fire(site, kinds=kinds)
 
 
 def maybe_fire_path(path):

@@ -60,6 +60,10 @@ METRIC_KINDS = {
     "nds_kernel_span_ms_total": "kernel_span",
     "nds_blocked_union_total": "blocked_union",
     "nds_blocked_union_windows_total": "blocked_union",
+    "nds_spill_total": "spill",
+    "nds_spill_bytes_in_total": "spill",
+    "nds_spill_bytes_out_total": "spill",
+    "nds_spill_evictions_total": "spill",
     "nds_fault_injected_total": "fault_injected",
     "nds_ladder_rung_total": "ladder_rung",
     "nds_watchdog_fire_total": "watchdog_fire",
@@ -391,6 +395,18 @@ class MetricsSink:
             kernel=kernel,
         )
 
+    def _h_spill(self, ev):
+        self.registry.inc("nds_spill_total", op=str(ev.get("op")))
+        self.registry.inc(
+            "nds_spill_bytes_in_total", int(ev.get("bytes_in") or 0)
+        )
+        self.registry.inc(
+            "nds_spill_bytes_out_total", int(ev.get("bytes_out") or 0)
+        )
+        self.registry.inc(
+            "nds_spill_evictions_total", int(ev.get("evictions") or 0)
+        )
+
     def _h_blocked_union(self, ev):
         self.registry.inc("nds_blocked_union_total")
         self.registry.inc(
@@ -531,6 +547,7 @@ _HANDLERS = {
     "pipeline_span": MetricsSink._h_pipeline_span,
     "kernel_span": MetricsSink._h_kernel_span,
     "blocked_union": MetricsSink._h_blocked_union,
+    "spill": MetricsSink._h_spill,
     "fault_injected": MetricsSink._h_fault_injected,
     "ladder_rung": MetricsSink._h_ladder_rung,
     "watchdog_fire": MetricsSink._h_watchdog_fire,

@@ -274,6 +274,10 @@ def profile_events(events) -> dict:
         "watchdog_fires": 0,
         "faults_injected": 0,
         "blocked_union_windows": 0,
+        "spill_ops": 0,
+        "spill_bytes_in": 0,
+        "spill_bytes_out": 0,
+        "spill_evictions": 0,
         "exec_cache_hits": 0,
         "exec_cache_misses": 0,
         "pipelines_fused": 0,
@@ -321,6 +325,11 @@ def profile_events(events) -> dict:
             tallies["faults_injected"] += 1
         elif k == "blocked_union":
             tallies["blocked_union_windows"] += int(ev.get("windows") or 0)
+        elif k == "spill":
+            tallies["spill_ops"] += 1
+            tallies["spill_bytes_in"] += int(ev.get("bytes_in") or 0)
+            tallies["spill_bytes_out"] += int(ev.get("bytes_out") or 0)
+            tallies["spill_evictions"] += int(ev.get("evictions") or 0)
         elif k == "exec_cache":
             tallies[
                 "exec_cache_hits" if ev.get("hit") else "exec_cache_misses"

@@ -94,6 +94,12 @@ EVENT_SCHEMA = {
     # (report.py; shrinks the blocked-union window before the allocator
     # fails)
     "mem_watermark": ("rss_bytes", "watermark_bytes"),
+    # one out-of-core (spilled) operator execution (engine/spill.py +
+    # exec's _spilled_join/_spilled_take/_spilled_distinct): host-pool
+    # traffic for a partitioned hash join / external sort / spilling
+    # distinct — bytes into/out of the pool, partition count, and how many
+    # segments tiered down to the spill dir
+    "spill": ("op", "partitions", "bytes_in", "bytes_out", "evictions"),
     # liveness beacon from the per-query memory-sampler thread
     # (obs/memwatch.py, armed by report.py while a traced query runs):
     # a hung query keeps heartbeating, so the hang is visible live on

@@ -44,6 +44,7 @@ from typing import Callable
 import jax
 
 from . import faults
+from .engine.spill import DEFAULT_FORCE_PARTITIONS as _SPILL_RETRY_PARTS
 from .io.fs import fs_open_atomic, io_retry_budget
 from .obs import trace as obs_trace
 from .obs.memwatch import MemorySampler
@@ -60,6 +61,15 @@ _WATCHDOG_MARK = "query watchdog"
 #: query keeps OOMing — small enough to relieve HBM pressure on any plan
 #: that routes through the blocked-union path, large enough to make progress
 _DEGRADED_WINDOW_ROWS = 1 << 18
+
+#: spill_retry partition count when the budgeter recorded no static
+#: recommendation (it only sizes partitions for `spill`-verdict plans):
+#: engine/spill.py's DEFAULT_FORCE_PARTITIONS — the same default the
+#: executor's force mode uses, imported above from the one source
+
+#: watchdog poll slice: the deadline loop re-checks spill progress at this
+#: granularity, so a timeout still fires within one slice of its budget
+_WATCHDOG_POLL_S = 0.25
 
 
 def engine_conf(session) -> dict:
@@ -178,8 +188,44 @@ class BenchReport:
         t = threading.Thread(
             target=_worker, name="nds-query-watchdog-worker", daemon=True
         )
+        # arm the progress seam: a stale beat from a previous query's spill
+        # phase must not extend THIS attempt's deadline
+        if hasattr(self.session, "_progress_ts"):
+            self.session._progress_ts = None
         t.start()
-        if not done.wait(timeout):
+        start = time.monotonic()
+        deadline = start + timeout
+        fired = False
+        while True:
+            wait_s = min(max(deadline - time.monotonic(), 0.0),
+                         _WATCHDOG_POLL_S)
+            if done.wait(wait_s):
+                break
+            now = time.monotonic()
+            if now < deadline:
+                continue
+            # deadline reached. A healthy out-of-core phase (external sort
+            # runs, join partitions, pool merges) beats through
+            # Session.spill_progress while it works; as long as the last
+            # beat is younger than the budget, the attempt is slow but
+            # ALIVE — re-arm one budget past the beat instead of
+            # misclassifying it as a hang. A wedged query stops beating,
+            # so the watchdog still fires one budget after the last beat.
+            # Only beats from THIS attempt's worker thread count: an
+            # abandoned previous attempt's zombie worker still beats on
+            # the shared session, and honoring it would let a genuinely
+            # hung next query stall the stream forever.
+            prog = getattr(self.session, "_progress_ts", None)
+            if (
+                isinstance(prog, tuple)
+                and prog[0] == t.ident
+                and now - prog[1] < timeout
+            ):
+                deadline = prog[1] + timeout
+                continue
+            fired = True
+            break
+        if fired:
             if self.tracer is not None:
                 self.tracer.emit(
                     "watchdog_fire", query=self._name, budget_s=timeout
@@ -202,7 +248,7 @@ class BenchReport:
         rec = getattr(self.session, "last_plan_budget", None)
         if not isinstance(rec, dict):
             return None
-        if rec.get("verdict") not in ("blocked", "over", "reject"):
+        if rec.get("verdict") not in ("blocked", "spill", "over", "reject"):
             return None
         return rec
 
@@ -265,6 +311,8 @@ class BenchReport:
                 return "recover_retry"
             if "shrink_union_window" not in taken:
                 return "shrink_union_window"
+            if "spill_retry" not in taken and self._spill_applicable():
+                return "spill_retry"
             return None
         if kind == faults.HOST_OOM:
             return "recover_retry" if "recover_retry" not in taken else None
@@ -275,9 +323,26 @@ class BenchReport:
             return None
         return None
 
+    def _spill_applicable(self) -> bool:
+        """True when an unpredicted device OOM can still retry through the
+        host spill pool: the last planned statement carries an out-of-core
+        seam (budget_plan records `spillable` for every verdict), spill
+        isn't disabled, and the failed attempt didn't already run forced
+        out-of-core (re-forcing an identical mode would be recover_retry
+        with extra steps)."""
+        conf = getattr(self.session, "conf", None)
+        if conf is None:
+            return False
+        mode = str(conf.get("engine.spill", "auto")).lower()
+        if mode in ("off", "force"):
+            return False
+        rec = getattr(self.session, "last_plan_budget", None)
+        return bool(isinstance(rec, dict) and rec.get("spillable"))
+
     def _apply_rung(self, rung: str, kind: str, io_attempt: int):
         session = self.session
-        if rung in ("recover_retry", "shrink_union_window", "budget_shrink"):
+        if rung in ("recover_retry", "shrink_union_window", "budget_shrink",
+                    "spill_retry"):
             if hasattr(session, "recover_memory"):
                 session.recover_memory(
                     "device memory exhausted"
@@ -312,6 +377,23 @@ class BenchReport:
                 new = max(int(cur) // 2, 4096) if cur else _DEGRADED_WINDOW_ROWS
                 conf["engine.union_agg_window_rows"] = new
                 return {"window_rows": new}
+        if rung == "spill_retry":
+            # graceful degradation ahead of hard failure: the retry routes
+            # every eligible join/sort/distinct through the host spill
+            # pool (exec's `force` mode). Persistent, like the window
+            # shrink — later statements of this degraded session stay
+            # out-of-core rather than re-walking the ladder per query.
+            conf = getattr(session, "conf", None)
+            if conf is not None:
+                rec = getattr(session, "last_plan_budget", None) or {}
+                parts = (
+                    int(rec.get("spill_partitions") or 0)
+                    or _SPILL_RETRY_PARTS
+                )
+                conf["engine.spill"] = "force"
+                conf["engine.spill_partitions"] = parts
+                return {"partitions": parts}
+            return None
         if rung == "io_backoff_retry":
             _, base = io_retry_budget()
             delay = next(faults.backoff_delays(1, base * (2 ** io_attempt)), 0.0)
@@ -400,11 +482,22 @@ class BenchReport:
                 conf["engine.union_agg_window_rows"] = new
             if hasattr(session, "_mem_pressure"):
                 session._mem_pressure = True
+            # host-tier relief: tier the spill pool's RAM-resident segments
+            # down to disk BEFORE the allocator fails (the pool is touched
+            # only if it already exists — pre-emption must not build one)
+            spilled = 0
+            pool = getattr(session, "_spill_pool", None)
+            if pool is not None:
+                try:
+                    spilled = pool.evict_host()
+                except Exception:
+                    spilled = 0  # relief is best-effort, never fatal here
             rungs.append({
                 "rung": "host_watermark_shrink",
                 "kind": faults.HOST_OOM,
                 "rss_bytes": int(rss),
                 **({"window_rows": new} if new else {}),
+                **({"spill_segments_evicted": spilled} if spilled else {}),
             })
             if self.tracer is not None:
                 self.tracer.emit(

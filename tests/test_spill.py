@@ -1,0 +1,685 @@
+"""Out-of-core execution: the host-RAM spill pool (engine/spill.py) and the
+executor's spilled paths — partitioned hash join, external sort, spilling
+distinct (exec._spilled_join/_spilled_take/_spilled_distinct).
+
+Path-equality oracle (the test_blocked_union_agg pattern): every spilled
+path must produce results identical to the direct path — bit-identical
+ints/strings/decimals, exact row order for sorts (the spilled sort reuses
+the direct path's own permutation) — across nulls, strings, decimals and
+empty inputs. Plus the robustness wiring: the budgeter's `spill` verdict +
+static partition counts, the verifier's spill-annotation invariants, the
+report ladder's spill_retry rung (injected-OOM integration), spill-IO fault
+backoff, the crash-orphan sweep, and the progress-aware watchdog.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import time
+from decimal import Decimal
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from nds_tpu import faults
+from nds_tpu.engine import plan as P
+from nds_tpu.engine import spill as SP
+from nds_tpu.engine.session import Session, _Entry
+from nds_tpu.report import BenchReport
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults(monkeypatch):
+    monkeypatch.delenv("NDS_FAULT_SPEC", raising=False)
+    monkeypatch.delenv("NDS_SPILL_DIR", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+N = 4000
+
+
+def _fact(seed):
+    r = np.random.default_rng(seed)
+    ks = r.integers(1, 40, N)
+    vs = r.integers(-50, 50, N)
+    return pa.table(
+        {
+            "k": pa.array(
+                [None if i % 13 == 0 else int(x) for i, x in enumerate(ks)],
+                pa.int32(),
+            ),
+            "cat": pa.array(
+                [["Books", "Music", "Shoes", None][int(x) % 4] for x in ks]
+            ),
+            "v": pa.array(
+                [None if i % 7 == 0 else int(x) for i, x in enumerate(vs)],
+                pa.int32(),
+            ),
+            "amt": pa.array(
+                [Decimal(int(x) * 7) / 100 for x in vs], pa.decimal128(7, 2)
+            ),
+            "f": pa.array([float(x) / 3 for x in vs], pa.float64()),
+        }
+    )
+
+
+def _dup_dim(seed=5):
+    # DUPLICATED join keys: keeps the dense/packed fast paths out (they
+    # need right-side uniqueness), so the generic sort join — the path the
+    # out-of-core rewrite replaces — is what actually runs
+    r = np.random.default_rng(seed)
+    return pa.table(
+        {
+            "dk": pa.array(
+                [int(x) for x in r.integers(1, 40, 300)], pa.int32()
+            ),
+            "dv": pa.array([int(x) for x in r.integers(0, 9, 300)], pa.int32()),
+        }
+    )
+
+
+def _session(tmp_path, **conf):
+    s = Session(conf={"engine.spill_dir": str(tmp_path / "spill"), **conf})
+    s.register_arrow("t1", _fact(1))
+    s.register_arrow("t2", _fact(2))
+    s.register_arrow("d", _dup_dim())
+    return s
+
+
+def _pair(tmp_path, **spill_conf):
+    direct = _session(tmp_path, **{"engine.spill": "off"})
+    forced = _session(
+        tmp_path,
+        **{"engine.spill": "force", "engine.spill_partitions": 4, **spill_conf},
+    )
+    return direct, forced
+
+
+def _oracle(tmp_path, sql, **spill_conf):
+    direct, forced = _pair(tmp_path, **spill_conf)
+    want = direct.sql(sql).collect().to_pylist()
+    forced.last_spill = None
+    got = forced.sql(sql).collect().to_pylist()
+    assert got == want, (sql, want[:3], got[:3])
+    return forced
+
+
+# ---------------------------------------------------------------------------
+# path-equality oracles
+# ---------------------------------------------------------------------------
+
+
+def test_spilled_inner_join_equals_direct(tmp_path):
+    forced = _oracle(
+        tmp_path,
+        "select t1.k, d.dv, sum(t1.v) sv, count(*) c, sum(t1.amt) sa "
+        "from t1, d where t1.k = d.dk group by t1.k, d.dv "
+        "order by t1.k, d.dv",
+    )
+    assert forced.last_spill and forced.last_spill["ops"] >= 1
+    assert forced.last_spill["partitions"] == 4
+    assert forced.last_spill["bytes_in"] > 0
+
+
+def test_spilled_left_join_equals_direct(tmp_path):
+    # null-keyed left rows must null-extend exactly as the direct path's
+    forced = _oracle(
+        tmp_path,
+        "select t1.k, t1.cat, d.dv from t1 left join d on t1.k = d.dk "
+        "order by t1.k, t1.cat, d.dv",
+    )
+    assert forced.last_spill and forced.last_spill["ops"] >= 1
+
+
+def test_spilled_join_empty_input(tmp_path):
+    forced = _oracle(
+        tmp_path,
+        "select t1.k, d.dv from t1, d where t1.k = d.dk and t1.v > 1000 "
+        "order by t1.k, d.dv",
+    )
+    assert forced.last_spill  # the spilled path ran, over zero rows
+
+
+def test_spilled_sort_equals_direct_exact_order(tmp_path):
+    # the external sort reuses the direct path's own permutation, so even
+    # tie rows land in the identical order — exact list equality, no
+    # order-by tie-breaking needed
+    forced = _oracle(
+        tmp_path,
+        "select k, cat, v, amt, f from t1 order by cat, k",
+    )
+    assert forced.last_spill and forced.last_spill["ops"] >= 1
+
+
+def test_spilled_distinct_and_union(tmp_path):
+    forced = _oracle(
+        tmp_path,
+        "select distinct k, cat from t1 order by k, cat",
+    )
+    assert forced.last_spill and forced.last_spill["ops"] >= 1
+    _oracle(
+        tmp_path,
+        "select k, v from t1 union select k, v from t2 order by k, v",
+    )
+
+
+def test_spilled_distinct_empty_after_filter(tmp_path):
+    _oracle(
+        tmp_path,
+        "select distinct k from t1 where v > 1000 order by k",
+    )
+
+
+def test_disk_eviction_roundtrip_and_cleanup(tmp_path):
+    # a 1-byte pool budget tiers every non-latest segment to disk; results
+    # stay identical and released segment files are unlinked
+    forced = _oracle(
+        tmp_path,
+        "select t1.k, d.dv, count(*) c from t1, d where t1.k = d.dk "
+        "group by t1.k, d.dv order by t1.k, d.dv",
+        **{"engine.spill_pool_bytes": 1},
+    )
+    assert forced.last_spill["evictions"] > 0
+    assert not glob.glob(str(tmp_path / "spill" / "spill-*.npz"))
+
+
+def test_annotation_driven_auto_mode(tmp_path):
+    # default `auto` mode spills exactly the nodes the budgeter annotated
+    direct = _session(tmp_path, **{"engine.spill": "off"})
+    q = (
+        "select t1.k, d.dv, count(*) c from t1, d where t1.k = d.dk "
+        "group by t1.k, d.dv order by t1.k, d.dv"
+    )
+    want = direct.sql(q).collect().to_pylist()
+    auto = _session(tmp_path)  # engine.spill defaults to auto
+    res = auto.sql(q)
+    assert auto.last_spill is None
+    from nds_tpu.analysis.budget import _annotate_spill
+
+    _annotate_spill(res.plan, 4)  # what a `spill` verdict would have done
+    assert res.collect().to_pylist() == want
+    assert auto.last_spill and auto.last_spill["ops"] >= 1
+    # and UNANNOTATED plans never touch the pool in auto mode
+    auto.last_spill = None
+    assert auto.sql(q).collect().to_pylist() == want
+    assert auto.last_spill is None
+
+
+# ---------------------------------------------------------------------------
+# spill events / live metrics
+# ---------------------------------------------------------------------------
+
+
+def test_spill_events_schema_and_metrics(tmp_path):
+    from nds_tpu.obs.metrics import MetricsSink
+    from nds_tpu.obs.trace import EVENT_SCHEMA, Tracer
+
+    forced = _session(
+        tmp_path, **{"engine.spill": "force", "engine.spill_partitions": 4}
+    )
+    forced.tracer = Tracer()  # in-memory collector
+    forced.sql(
+        "select distinct k, cat from t1 order by k, cat"
+    ).collect()
+    evs = [e for e in forced.tracer.events if e["kind"] == "spill"]
+    assert evs, "spilled ops must emit `spill` events"
+    for ev in evs:
+        assert set(EVENT_SCHEMA["spill"]) <= set(ev)
+        assert ev["op"] in ("join", "sort", "distinct")
+        assert ev["partitions"] == 4
+    sink = MetricsSink()
+    for ev in evs:
+        sink.record(ev)
+    total = sum(sink.registry.counter_series("nds_spill_total").values())
+    assert total == len(evs)
+    assert (
+        sink.registry.counter_value("nds_spill_bytes_in_total")
+        == sum(e["bytes_in"] for e in evs)
+    )
+
+
+def test_spill_tallies_in_profiler(tmp_path):
+    from nds_tpu.obs.reader import profile_events
+    from nds_tpu.obs.trace import Tracer
+
+    forced = _session(
+        tmp_path, **{"engine.spill": "force", "engine.spill_partitions": 4}
+    )
+    forced.tracer = Tracer()
+    forced.sql("select k, cat from t1 order by cat, k").collect()
+    prof = profile_events(forced.tracer.events)
+    assert prof["tallies"]["spill_ops"] >= 1
+    assert prof["tallies"]["spill_bytes_in"] > 0
+
+
+# ---------------------------------------------------------------------------
+# budgeter verdict + verifier invariants
+# ---------------------------------------------------------------------------
+
+
+def _schema_session(**conf):
+    from nds_tpu.schema import get_schemas
+
+    sess = Session(conf={"engine.plan_budget": "off", **conf})
+    for name, schema in get_schemas(True).items():
+        sess.catalog.entries[name] = _Entry(schema=schema)
+    return sess
+
+
+def _template_plans(sess, qnum, sf):
+    from nds_tpu.datagen.query_streams import instantiate
+    from nds_tpu.engine.sql.parser import parse_script
+
+    rng = np.random.default_rng(np.random.SeedSequence([0, 0]))
+    return [
+        sess.run_stmt(s).plan
+        for s in parse_script(instantiate(qnum, rng, sf))
+    ]
+
+
+def test_budget_spill_verdict_round5_set():
+    from nds_tpu.analysis import budget as B
+
+    # q6/q7: the round-5 SF10 OOM queries that previously landed on the
+    # passive `over` verdict now pin onto `spill` with a statically sized
+    # power-of-two partition count; q5 keeps its blocked seam; q14 stays
+    # beyond the reject line (admission control is not bypassed by spill)
+    for q, expect in ((5, "blocked"), (6, "spill"), (7, "spill")):
+        sess = _schema_session()
+        pbs = [
+            B.analyze_plan(p, sess.catalog, scale_factor=10.0)
+            for p in _template_plans(sess, q, 10.0)
+        ]
+        assert [pb.verdict for pb in pbs] == [expect], (q, pbs)
+        for pb in pbs:
+            assert pb.spillable
+            if expect == "spill":
+                sp = pb.spill_partitions
+                assert sp and sp & (sp - 1) == 0 and 2 <= sp <= 256
+    sess = _schema_session()
+    pbs = [
+        B.analyze_plan(p, sess.catalog, scale_factor=10.0)
+        for p in _template_plans(sess, 14, 10.0)
+    ]
+    assert all(pb.verdict == "reject" for pb in pbs)
+    # SF1 stays all-direct (zero false positives — the corpus gate's pin)
+    sess1 = _schema_session()
+    pb1 = B.analyze_plan(
+        _template_plans(sess1, 6, 1.0)[0], sess1.catalog, scale_factor=1.0
+    )
+    assert pb1.verdict == "direct" and pb1.spill_partitions is None
+
+
+def test_budget_plan_hook_annotates_and_arms_ladder():
+    from nds_tpu.analysis.budget import budget_plan, spillable_node
+
+    sess = _schema_session()
+    sess.conf["engine.plan_budget"] = "on"
+    sess.conf["engine.plan_budget_sf"] = 10.0
+    (plan,) = _template_plans(sess, 6, 10.0)
+    pb = budget_plan(plan, sess)
+    assert pb.verdict == "spill"
+    rec = sess.last_plan_budget
+    assert rec["verdict"] == "spill" and rec["spillable"]
+    assert rec["spill_partitions"] == pb.spill_partitions
+    annotated = [
+        v
+        for v in P.walk_plan(plan)
+        if isinstance(v, P.PlanNode)
+        and getattr(v, "spill_partitions", None) is not None
+    ]
+    assert annotated and all(spillable_node(v) for v in annotated)
+    # the verifier accepts the budgeter's own annotations
+    from nds_tpu.analysis.verifier import verify_plan
+
+    verify_plan(plan, sess.catalog)
+    # warn mode is observe-only: no annotation lands
+    sess2 = _schema_session()
+    sess2.conf["engine.plan_budget"] = "warn"
+    sess2.conf["engine.plan_budget_sf"] = 10.0
+    (plan2,) = _template_plans(sess2, 6, 10.0)
+    budget_plan(plan2, sess2)
+    assert sess2.last_plan_budget["verdict"] == "spill"
+    assert not [
+        v
+        for v in P.walk_plan(plan2)
+        if isinstance(v, P.PlanNode)
+        and getattr(v, "spill_partitions", None) is not None
+    ]
+
+
+def test_verifier_flags_bad_spill_annotations(tmp_path):
+    from nds_tpu.analysis.verifier import PlanVerifyError, verify_plan
+
+    sess = _session(tmp_path)
+    res = sess.sql("select k, cat from t1 order by cat, k")
+    sort = next(
+        v for v in P.walk_plan(res.plan) if isinstance(v, P.Sort)
+    )
+    # wrong node class: a Project does not own an out-of-core rewrite
+    proj = next(
+        v for v in P.walk_plan(res.plan) if isinstance(v, P.Project)
+    )
+    proj.spill_partitions = 4
+    with pytest.raises(PlanVerifyError, match="spill"):
+        verify_plan(res.plan, sess.catalog)
+    del proj.spill_partitions
+    # non-power-of-two partition count
+    sort.spill_partitions = 3
+    with pytest.raises(PlanVerifyError, match="power of two"):
+        verify_plan(res.plan, sess.catalog)
+    sort.spill_partitions = 4  # sane: accepted
+    verify_plan(res.plan, sess.catalog)
+
+
+# ---------------------------------------------------------------------------
+# ladder: spill_retry + spill-IO backoff
+# ---------------------------------------------------------------------------
+
+
+def _flaky(sequence):
+    calls = {"n": 0}
+
+    def fn():
+        i = calls["n"]
+        calls["n"] += 1
+        err = sequence[i] if i < len(sequence) else None
+        if err is not None:
+            raise err
+
+    fn.calls = calls
+    return fn
+
+
+def test_ladder_spill_retry_after_shrink():
+    sess = Session()
+    sess.last_plan_budget = {
+        "verdict": "over", "spillable": True, "spill_partitions": 4,
+    }
+    oom = lambda: faults.InjectedOOM("RESOURCE_EXHAUSTED: x")
+    fn = _flaky([oom(), oom(), oom()])
+    s = BenchReport(sess).report_on(fn, retry_oom=True)
+    assert s["queryStatus"] == ["CompletedWithTaskFailures"]
+    assert [r["rung"] for r in s["ladder"]] == [
+        "recover_retry", "shrink_union_window", "spill_retry",
+    ]
+    assert sess.conf["engine.spill"] == "force"
+    assert sess.conf["engine.spill_partitions"] == 4
+    # degradation persists for the rest of the stream's session, so the
+    # rung is NOT offered again (re-forcing would waste an attempt)
+    s2 = BenchReport(sess).report_on(
+        _flaky([oom(), oom(), oom()]), retry_oom=True
+    )
+    assert s2["queryStatus"] == ["Failed"]
+    assert [r["rung"] for r in s2["ladder"]] == [
+        "recover_retry", "shrink_union_window",
+    ]
+
+
+def test_ladder_no_spill_retry_without_seam():
+    # no budget record (or an unspillable plan): the pre-spill ladder
+    sess = Session()
+    oom = lambda: faults.InjectedOOM("RESOURCE_EXHAUSTED: x")
+    s = BenchReport(sess).report_on(
+        _flaky([oom(), oom(), oom()]), retry_oom=True
+    )
+    assert s["queryStatus"] == ["Failed"]
+    assert [r["rung"] for r in s["ladder"]] == [
+        "recover_retry", "shrink_union_window",
+    ]
+
+
+def test_injected_oom_completes_via_spill_retry(tmp_path):
+    # the acceptance-criteria integration: a query that device-OOMs on an
+    # unspilled join plan completes through the spill_retry rung, with
+    # spill evidence on the session
+    sess = _session(tmp_path)
+    q = (
+        "select t1.k, count(*) c from t1, d where t1.k = d.dk "
+        "group by t1.k order by t1.k"
+    )
+    expect = sess.sql(q).collect().to_pylist()
+    faults.install("oom:exec:qspill:3")
+
+    def runq():
+        with faults.scope("qspill"):
+            assert sess.sql(q).collect().to_pylist() == expect
+
+    s = BenchReport(sess).report_on(runq, retry_oom=True, name="qspill")
+    assert s["queryStatus"] == ["CompletedWithTaskFailures"]
+    assert [r["rung"] for r in s["ladder"]] == [
+        "recover_retry", "shrink_union_window", "spill_retry",
+    ]
+    assert sess.last_spill and sess.last_spill["ops"] >= 1
+
+
+def test_spill_io_fault_retries_with_backoff(tmp_path, monkeypatch):
+    monkeypatch.setenv("NDS_IO_RETRIES", "2")
+    monkeypatch.setenv("NDS_IO_BACKOFF", "0")
+    sess = _session(
+        tmp_path,
+        **{
+            "engine.spill": "force",
+            "engine.spill_partitions": 4,
+            "engine.spill_pool_bytes": 1,  # every put tiers to disk
+        },
+    )
+    faults.install("io:spill:write:1")
+
+    def runq():
+        sess.sql("select distinct k from t1 order by k").collect()
+
+    s = BenchReport(sess).report_on(runq, retry_oom=True)
+    assert s["queryStatus"] == ["CompletedWithTaskFailures"]
+    assert "io_backoff_retry" in [r["rung"] for r in s["ladder"]]
+    # a real (wrapped) segment-IO failure classifies io_transient too
+    assert faults.classify(SP.SpillIOError("disk went away")) == (
+        faults.IO_TRANSIENT
+    )
+
+
+def test_spill_crash_rule_sails_through(tmp_path):
+    sess = _session(
+        tmp_path,
+        **{
+            "engine.spill": "force",
+            "engine.spill_partitions": 4,
+            "engine.spill_pool_bytes": 1,
+        },
+    )
+    faults.install("crash:spill:write")
+    with pytest.raises(faults.InjectedCrash):
+        sess.sql("select distinct k from t1 order by k").collect()
+
+
+# ---------------------------------------------------------------------------
+# crash hygiene: orphan sweep
+# ---------------------------------------------------------------------------
+
+
+def _write_manifest(d, pid, app):
+    with open(os.path.join(d, f"spill-manifest-{pid}.json"), "w") as f:
+        json.dump({"magic": SP._MANIFEST_MAGIC, "pid": pid, "app": app}, f)
+
+
+def test_sweep_removes_dead_process_segments(tmp_path):
+    d = str(tmp_path)
+    p = subprocess.Popen(["sleep", "0"])
+    p.wait()
+    _write_manifest(d, p.pid, "deadapp-abc")
+    open(os.path.join(d, "spill-deadapp-abc-0.npz"), "wb").close()
+    open(os.path.join(d, "spill-deadapp-abc-1.npz.tmp-1234"), "wb").close()
+    _write_manifest(d, os.getpid(), "liveapp-xyz")
+    open(os.path.join(d, "spill-liveapp-xyz-0.npz"), "wb").close()
+    open(os.path.join(d, "unrelated.txt"), "w").close()
+    # a foreign manifest (wrong magic) protects nothing and is untouched
+    with open(os.path.join(d, "spill-manifest-99999999.json"), "w") as f:
+        json.dump({"magic": "something-else", "pid": 1}, f)
+    # a torn manifest write from the dead process is swept too; a torn
+    # manifest of a LIVE process is kept
+    open(
+        os.path.join(d, f"spill-manifest-{p.pid}.json.tmp-abcd1234"), "w"
+    ).close()
+    open(
+        os.path.join(d, f"spill-manifest-{os.getpid()}.json.tmp-ef567890"),
+        "w",
+    ).close()
+    removed = SP.sweep_orphans(d)
+    left = sorted(os.listdir(d))
+    assert removed == 4
+    assert "spill-liveapp-xyz-0.npz" in left  # live process: kept
+    assert "unrelated.txt" in left  # foreign file: never touched
+    assert "spill-manifest-99999999.json" in left  # wrong magic: untouched
+    assert f"spill-manifest-{os.getpid()}.json.tmp-ef567890" in left
+    assert not any("deadapp" in x for x in left)
+    assert not any(f"manifest-{p.pid}" in x for x in left)
+
+
+def test_session_start_sweeps_orphans(tmp_path, monkeypatch):
+    d = str(tmp_path / "spill")
+    os.makedirs(d)
+    p = subprocess.Popen(["sleep", "0"])
+    p.wait()
+    _write_manifest(d, p.pid, "crashed-run")
+    open(os.path.join(d, "spill-crashed-run-0.npz"), "wb").close()
+    monkeypatch.setattr(SP, "_SWEPT_DIRS", set())  # fresh process view
+    Session(conf={"engine.spill_dir": d})
+    assert not glob.glob(os.path.join(d, "spill-crashed-run-*"))
+    # crash -> restart regression: a pool in the restarted session reuses
+    # the swept dir cleanly (write + read back through the disk tier)
+    sess = _session(
+        tmp_path,
+        **{
+            "engine.spill": "force",
+            "engine.spill_partitions": 4,
+            "engine.spill_pool_bytes": 1,
+            "engine.spill_dir": d,
+        },
+    )
+    out = sess.sql("select distinct k from t1 order by k").collect()
+    assert out.num_rows > 0
+    assert sess.last_spill["evictions"] > 0
+
+
+# ---------------------------------------------------------------------------
+# progress-aware watchdog (heartbeat-during-spill satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_spares_slow_but_beating_spill():
+    sess = Session(conf={"engine.query_timeout": "0.4"})
+
+    def beating():
+        # a healthy external sort: total wall 0.9s >> the 0.4s budget, but
+        # every merge/partition phase beats through the progress seam
+        for _ in range(6):
+            time.sleep(0.15)
+            sess.spill_progress()
+
+    s = BenchReport(sess).report_on(beating, retry_oom=True)
+    assert s["queryStatus"] == ["Completed"]
+    assert "ladder" not in s
+
+
+def test_watchdog_still_fires_without_beats():
+    sess = Session(conf={"engine.query_timeout": "0.4"})
+
+    def silent():
+        time.sleep(2.0)
+
+    t0 = time.monotonic()
+    s = BenchReport(sess).report_on(silent, retry_oom=True)
+    elapsed = time.monotonic() - t0
+    assert s["queryStatus"] == ["Failed"]
+    assert s["failureKind"] == faults.TIMEOUT
+    assert elapsed < 1.5  # abandoned well before the 2s hang ends
+
+
+def test_stale_beat_does_not_extend_next_query():
+    sess = Session(conf={"engine.query_timeout": "0.4"})
+    sess.spill_progress()  # previous query's beat
+
+    def silent():
+        time.sleep(2.0)
+
+    t0 = time.monotonic()
+    s = BenchReport(sess).report_on(silent, retry_oom=True)
+    assert s["queryStatus"] == ["Failed"]
+    assert time.monotonic() - t0 < 1.5
+
+
+def test_zombie_worker_beats_do_not_shield_a_hang():
+    # an ABANDONED previous attempt's worker keeps beating on the shared
+    # session; the next query's watchdog must ignore those beats (they
+    # carry the zombie's thread identity) or a genuine hang could stall
+    # the stream forever
+    import threading
+
+    sess = Session(conf={"engine.query_timeout": "0.4"})
+    stop = threading.Event()
+
+    def zombie():
+        while not stop.wait(0.1):
+            sess.spill_progress()
+
+    z = threading.Thread(target=zombie, daemon=True)
+    z.start()
+    try:
+        def silent():
+            time.sleep(2.0)
+
+        t0 = time.monotonic()
+        s = BenchReport(sess).report_on(silent, retry_oom=True)
+        assert s["queryStatus"] == ["Failed"]
+        assert s["failureKind"] == faults.TIMEOUT
+        assert time.monotonic() - t0 < 1.5
+    finally:
+        stop.set()
+        z.join(2)
+
+
+# ---------------------------------------------------------------------------
+# pool units
+# ---------------------------------------------------------------------------
+
+
+def test_pool_put_read_release_accounting(tmp_path):
+    import jax.numpy as jnp
+
+    from nds_tpu.engine.columnar import Column, Table
+    from nds_tpu.dtypes import INT64
+
+    pool = SP.SpillPool(budget_bytes=1 << 20, spill_dir=str(tmp_path))
+    t = Table(
+        {"x": Column(jnp.arange(1024, dtype=jnp.int64), INT64)}, 1000
+    )
+    seg = pool.put(t)
+    assert seg.nrows == 1000
+    assert pool.stats["bytes_in"] == seg.nbytes == 8 * 1000
+    out = SP.assemble_segments(pool, [seg, seg])
+    assert out.nrows == 2000
+    assert pool.stats["bytes_out"] == 2 * seg.nbytes
+    pool.release([seg])
+    assert pool.host_bytes == 0
+
+
+def test_pool_ram_only_over_budget_keeps_data(tmp_path):
+    import jax.numpy as jnp
+
+    from nds_tpu.engine.columnar import Column, Table
+    from nds_tpu.dtypes import INT64
+
+    pool = SP.SpillPool(budget_bytes=1, spill_dir=None)  # no disk tier
+    segs = [
+        pool.put(
+            Table({"x": Column(jnp.arange(1024, dtype=jnp.int64), INT64)}, 64)
+        )
+        for _ in range(3)
+    ]
+    assert pool.stats["evictions"] == 0  # nothing to evict to
+    out = SP.assemble_segments(pool, segs)
+    assert out.nrows == 192  # data never dropped

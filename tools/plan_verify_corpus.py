@@ -89,8 +89,13 @@ def check_template(sess: Session, qnum: int, scale: float, rngseed: int) -> int:
 #: (BENCH_r05.json sf10.failed); the budgeter must flag >= 90% of them
 ROUND5_SF10_OOM = (5, 6, 7)
 
-_VERDICT_RANK = {"direct": 0, "unknown": 1, "blocked": 2, "over": 3,
-                 "reject": 4}
+#: verdicts that carry a PLANNED degradation (statically sized windows /
+#: partition counts) — the round-5 OOM set must pin onto these, not onto
+#: the passive `over` (which only arms the runtime ladder)
+PLANNED_DEGRADATION = ("blocked", "spill")
+
+_VERDICT_RANK = {"direct": 0, "unknown": 1, "blocked": 2, "spill": 3,
+                 "over": 4, "reject": 5}
 
 
 def budget_pass(use_decimal: bool, rngseed: int) -> int:
@@ -142,7 +147,14 @@ def budget_pass(use_decimal: bool, rngseed: int) -> int:
                     )
                 )
         else:
-            hits = [q for q in ROUND5_SF10_OOM if verdicts[q] != "direct"]
+            # the OOM set must land on a PLANNED degradation verdict —
+            # blocked (windowed union-agg) or spill (out-of-core partition
+            # counts) — so the first SF10 attempt already runs degraded
+            # instead of discovering the misfit as a device OOM
+            hits = [
+                q for q in ROUND5_SF10_OOM
+                if verdicts[q] in PLANNED_DEGRADATION
+            ]
             coverage = len(hits) / len(ROUND5_SF10_OOM)
             detail = ", ".join(
                 f"q{q}={verdicts[q]}@{peaks[q] / (1 << 30):.2f}G"
@@ -155,8 +167,9 @@ def budget_pass(use_decimal: bool, rngseed: int) -> int:
             if coverage < 0.9:
                 failures += 1
                 print(
-                    "plan_budget_corpus: FAIL: the budgeter must flag "
-                    ">= 90% of the round-5 SF10 device-OOM set"
+                    "plan_budget_corpus: FAIL: the budgeter must pin "
+                    ">= 90% of the round-5 SF10 device-OOM set onto the "
+                    f"{PLANNED_DEGRADATION} verdicts"
                 )
     return failures
 
