@@ -584,6 +584,83 @@ def main():
             OUT.setdefault("sf10", {})["error"] = str(exc)[:500]
         emit()
 
+    if os.environ.get("NDS_BENCH_MAINT_UNDER_LOAD"):
+        # opt-in robustness block: DM_* commits + a lease-safe vacuum
+        # racing a query stream over a tiny lakehouse warehouse, reported
+        # as maintenance throughput x query p99 degradation (the
+        # full_bench maintenance_under_load phase's metric, embedded in
+        # the bench artifact so rounds can track it). Fail-soft.
+        try:
+            OUT["maintenance_under_load"] = bench_maintenance_under_load()
+        except Exception as exc:
+            OUT["maintenance_under_load"] = {"error": str(exc)[:500]}
+        emit()
+
+
+def bench_maintenance_under_load():
+    """Maintenance-under-load at SF0.01 (NDS_BENCH_MAINT_UNDER_LOAD=1):
+    build (once, marker-cached) a tiny raw set + refresh set + lakehouse
+    warehouse + query stream under NDS_BENCH_MUL_DIR (default
+    /tmp/nds_bench_mul), then run nds_tpu.maintenance.
+    run_maintenance_under_load over a small query subset. Returns the
+    compact report dict (p99 degradation + dm throughput)."""
+    base = os.environ.get("NDS_BENCH_MUL_DIR", "/tmp/nds_bench_mul")
+    raw = os.path.join(base, "raw")
+    refresh = os.path.join(base, "refresh")
+    wh = os.path.join(base, "warehouse")
+    streams = os.path.join(base, "streams")
+    here = os.path.dirname(os.path.abspath(__file__))
+    ensure_data(scale=0.01, data_dir=raw, parallel=2)
+    if not os.path.exists(os.path.join(refresh, ".complete")):
+        subprocess.run(
+            [sys.executable, "-m", "nds_tpu.cli.gen_data", "--scale",
+             "0.01", "--parallel", "2", "--data_dir", refresh,
+             "--update", "1", "--overwrite_output"],
+            check=True, cwd=here, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        open(os.path.join(refresh, ".complete"), "w").close()
+    if not os.path.exists(os.path.join(wh, ".complete")):
+        subprocess.run(
+            [sys.executable, "-m", "nds_tpu.cli.transcode", raw, wh,
+             os.path.join(wh, "load.report"), "--output_format",
+             "lakehouse", "--output_mode", "overwrite"],
+            check=True, cwd=here, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        open(os.path.join(wh, ".complete"), "w").close()
+    stream_file = os.path.join(streams, "query_1.sql")
+    if not os.path.exists(stream_file):
+        from nds_tpu.datagen.query_streams import generate_streams
+
+        generate_streams(streams, 2, 0.01, rngseed=19620718)
+
+    from nds_tpu.maintenance import run_maintenance_under_load
+
+    report = run_maintenance_under_load(
+        warehouse_path=wh,
+        refresh_data_path=refresh,
+        stream_file=stream_file,
+        time_log_output_path=os.path.join(base, "mul_time.csv"),
+        report_path=os.path.join(base, "mul_report.json"),
+        spec_queries=os.environ.get(
+            "NDS_BENCH_MUL_FUNCS", "LF_SS,DF_SS"
+        ).split(","),
+        sub_queries=os.environ.get(
+            "NDS_BENCH_MUL_QUERIES", "query3,query7,query52"
+        ).split(","),
+    )
+    # compact: the artifact line carries the headline fields only
+    return {
+        k: report.get(k)
+        for k in (
+            "queries", "query_p99_ms_solo", "query_p99_ms_under_load",
+            "query_p99_degradation", "dm_functions", "dm_failed",
+            "dm_functions_per_s", "vacuums", "vacuum_files_removed",
+            "under_load_failed",
+        )
+    }
+
 
 def _sf10_data_dir() -> str:
     """SF10 data dir: NDS_BENCH_DATA_SF10 wins outright; else a

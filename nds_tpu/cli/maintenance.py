@@ -2,13 +2,18 @@
 
     python -m nds_tpu.cli.maintenance <warehouse_path> <refresh_data_path>
         <time_log> [--maintenance_queries LF_CS,DF_CS] [--property_file F]
-        [--json_summary_folder DIR] [--floats]
+        [--json_summary_folder DIR] [--floats] [--vacuum]
+
+Maintenance-under-load mode (`full_bench`'s opt-in phase): pass
+`--under_load_stream <query_N.sql>` and the DM functions run in a racing
+thread against that query stream, measured as maintenance throughput x
+query p99 degradation (`--under_load_report` gets the JSON).
 """
 
 import argparse
 
 from ..check import check_version
-from ..maintenance import run_maintenance
+from ..maintenance import run_maintenance, run_maintenance_under_load
 
 
 def main(argv=None):
@@ -40,7 +45,40 @@ def main(argv=None):
         action="store_true",
         help="use double instead of decimal for decimal-typed columns",
     )
+    parser.add_argument(
+        "--vacuum",
+        action="store_true",
+        help="expire old snapshots + delete unreferenced data files after "
+        "the refresh functions (reader-lease safe)",
+    )
+    parser.add_argument(
+        "--under_load_stream",
+        help="query stream file to run CONCURRENTLY with the refresh "
+        "functions (maintenance-under-load mode)",
+    )
+    parser.add_argument(
+        "--under_load_report",
+        help="JSON report path for maintenance-under-load metrics",
+    )
+    parser.add_argument(
+        "--under_load_queries",
+        type=lambda s: s.split(","),
+        help="comma separated stream-query subset for under-load mode",
+    )
     args = parser.parse_args(argv)
+    if args.under_load_stream:
+        run_maintenance_under_load(
+            warehouse_path=args.warehouse_path,
+            refresh_data_path=args.refresh_data_path,
+            stream_file=args.under_load_stream,
+            time_log_output_path=args.time_log,
+            report_path=args.under_load_report,
+            property_file=args.property_file,
+            spec_queries=args.maintenance_queries,
+            sub_queries=args.under_load_queries,
+            use_decimal=not args.floats,
+        )
+        return
     run_maintenance(
         warehouse_path=args.warehouse_path,
         refresh_data_path=args.refresh_data_path,
@@ -49,6 +87,7 @@ def main(argv=None):
         property_file=args.property_file,
         spec_queries=args.maintenance_queries,
         use_decimal=not args.floats,
+        vacuum_after=args.vacuum,
     )
 
 

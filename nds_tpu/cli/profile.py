@@ -119,6 +119,15 @@ def _render_profile(prof, top: int, per_query: bool):
               f"pool / {_fmt_bytes(t.get('spill_bytes_out', 0))} read "
               f"back; {t.get('spill_evictions', 0)} segment(s) tiered "
               f"to disk")
+    # transactional-lakehouse evidence (lake_commit/lake_vacuum events);
+    # .get() because compacted artifacts from pre-lakehouse-txn runs lack
+    # the keys
+    if t.get("lake_commits") or t.get("lake_commit_conflicts"):
+        print(f"== lakehouse: {t.get('lake_commits', 0)} commit(s) "
+              f"({t.get('lake_commit_rebases', 0)} rebased, "
+              f"{t.get('lake_commit_conflicts', 0)} conflict abort(s)); "
+              f"{t.get('lake_vacuums', 0)} vacuum(s) removed "
+              f"{t.get('lake_vacuum_files', 0)} file(s)")
     pb = prof.get("plan_budget") or {}
     if pb.get("verdicts"):
         verdicts = ", ".join(

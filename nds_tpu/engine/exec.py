@@ -315,7 +315,13 @@ class Executor:
 
     # ------------------------------------------------------------------
     def _exec_scan(self, node: P.Scan) -> Table:
-        t = self.catalog.load(node.table, node.columns)
+        # lake_version: the plan-time snapshot pin (Session._pin_lake_scans)
+        # — threading it here keeps the scan on ITS statement's snapshot
+        # even when another stream sharing this session has re-pinned the
+        # catalog entry, and after a device-OOM recovery wiped the cache
+        t = self.catalog.load(
+            node.table, node.columns, lake_version=node.lake_version
+        )
         uk = t.unique_key
         if uk is not None:
             uk = frozenset(f"{node.alias}.{n}" for n in uk)

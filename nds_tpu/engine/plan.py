@@ -28,6 +28,11 @@ class Scan(PlanNode):
     table: str  # catalog name
     alias: str  # column prefix in the output
     columns: list = None  # projection pushdown: subset of base columns or None
+    # lakehouse snapshot pin: the manifest version this statement resolved
+    # at plan time (Session._pin_lake_scans); None for non-lake tables. A
+    # dataclass field on purpose — it participates in plan.fingerprint, so
+    # plan-cache entries can never alias across snapshot versions.
+    lake_version: int = None
 
 
 @dataclass

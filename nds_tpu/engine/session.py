@@ -119,9 +119,16 @@ class _Entry:
         self.schema = schema  # nds_tpu Schema or None (infer)
         self.arrow = arrow  # pa.Table (in-memory)
         self.path = path  # file/dir path
-        self.fmt = fmt  # parquet | csv | orc
+        self.fmt = fmt  # parquet | csv | orc | lakehouse
         self.device_cols = {}  # per-column device cache: name -> Column
         self.nrows = None
+        # lakehouse snapshot pin (fmt == "lakehouse" only): the manifest
+        # version this entry's reads resolve against, the TableSnapshot
+        # handle itself, and the reader lease registered for it
+        # (lakehouse/leases.py) so vacuum never deletes pinned files
+        self.pinned_version = None
+        self.pinned_snapshot = None
+        self.lease_id = None
         # declared-PK verification memo: None = not checked yet, else bool.
         # The TABLE_PRIMARY_KEYS claim is about the DATA, and a table
         # registered under a TPC-DS name may hold anything (synthetic test
@@ -142,6 +149,10 @@ class Catalog:
         self.session = session
         self.entries = {}  # name -> _Entry
         self._use_tick = 0
+        # lakehouse pin holds (thread-local): table names whose snapshot
+        # pin a DML statement froze for its own nested reads — auto-pin
+        # must not re-resolve them mid-transaction (lakehouse/dml.py)
+        self._pin_holds = threading.local()
 
     def _cached_bytes(self, e) -> int:
         total = 0
@@ -188,14 +199,24 @@ class Catalog:
             tuple(Field(f.name, _infer_dtype(f.type)) for f in at)
         )
 
-    def _dataset(self, e: _Entry):
+    def _dataset(self, e: _Entry, snapshot=None):
         # hive partitioning discovery: the transcode phase writes fact tables
         # as <date_sk>=<value>/ directories; declare the partition field type
         # from the table schema so keys round-trip with the right dtype
         if e.fmt == "lakehouse":
             from ..lakehouse.table import LakehouseTable
 
-            return LakehouseTable(e.path).dataset()
+            # snapshot-isolated read: a pinned entry resolves against its
+            # plan-time manifest version — a racing replace()/append()
+            # cannot change what this query sees. Unpinned (direct/legacy)
+            # access still resolves the head once per dataset build.
+            # `snapshot` (when the caller captured one) wins outright:
+            # load() passes its plan's handle so a concurrent re-pin of
+            # the entry cannot swap the manifest mid-read.
+            snap = snapshot if snapshot is not None else e.pinned_snapshot
+            if snap is None:
+                snap = LakehouseTable(e.path).snapshot()
+            return snap.dataset()
         part = "hive"
         fmt = e.fmt
         if e.schema is not None:
@@ -236,13 +257,89 @@ class Catalog:
             return e.arrow.schema
         return self._dataset(e).schema
 
-    def load(self, name, columns=None) -> Table:
+    # ---- lakehouse snapshot pins ----------------------------------------
+    def pin_lakehouse(self, name, version=None):
+        """Resolve (or restore) a lakehouse entry's snapshot pin.
+
+        `version=None` resolves the current head ONCE and pins it — unless
+        the name is held (a DML transaction froze it for its nested reads).
+        When the pin moves (the table advanced under us, or a plan carries
+        an explicit older pin), every cached device column and plan result
+        derived from the old snapshot is invalidated first. The pin is
+        registered in the process-wide reader-lease table so a concurrent
+        vacuum can never delete the pinned snapshot's files. Returns the
+        pinned version, or None for non-lakehouse names."""
+        e = self.entries.get(name)
+        if e is None or e.fmt != "lakehouse":
+            return None
+        from ..lakehouse.leases import LEASES, resolve_lease_ttl
+        from ..lakehouse.table import LakehouseTable
+
+        held = getattr(self._pin_holds, "names", None)
+        if version is None and held and name in held:
+            return e.pinned_version
+        lt = LakehouseTable(e.path, conf=self.session.conf)
+        snap = lt.snapshot(version)
+        ttl = resolve_lease_ttl(self.session.conf)
+        if e.pinned_version != snap.version:
+            # the pin moves: anything cached from the old snapshot is
+            # stale (device columns, plan results, join orders)
+            self.invalidate(name)
+            e.pinned_version = snap.version
+            e.pinned_snapshot = snap
+            e.lease_id = LEASES.acquire(
+                lt.root, snap.version, snap.rel_files, ttl
+            )
+        else:
+            if e.pinned_snapshot is None:
+                e.pinned_snapshot = snap
+            if e.lease_id is None or not LEASES.renew(e.lease_id, ttl):
+                e.lease_id = LEASES.acquire(
+                    lt.root, snap.version, snap.rel_files, ttl
+                )
+        return e.pinned_version
+
+    def hold_pins(self, names):
+        """Context manager freezing the named tables' pins for this thread:
+        nested statements (a DML's survivor scan, scalar subqueries) keep
+        reading the transaction's snapshot instead of re-resolving the
+        head mid-transaction."""
+        import contextlib
+
+        holds = self._pin_holds
+
+        @contextlib.contextmanager
+        def _hold():
+            prev = getattr(holds, "names", None)
+            holds.names = frozenset(prev or ()) | {
+                str(n).lower() for n in names
+            }
+            try:
+                yield
+            finally:
+                holds.names = prev
+
+        return _hold()
+
+    def load(self, name, columns=None, lake_version=None) -> Table:
         """Load (a projection of) a table to device, caching per column so
         repeated queries over different column subsets never re-read or
-        re-upload what is already in HBM."""
+        re-upload what is already in HBM.
+
+        `lake_version`: the plan-time snapshot pin this scan must read
+        (engine/exec.py threads it from Scan.lake_version). When another
+        statement has since moved the entry's pin, the entry is re-pinned
+        to the scan's version first — per-plan snapshot isolation even on
+        a session shared by concurrent streams."""
         e = self.entries.get(name)
         if e is None:
             raise KeyError(f"unknown table {name}")
+        if (
+            lake_version is not None
+            and e.fmt == "lakehouse"
+            and e.pinned_version != lake_version
+        ):
+            self.pin_lakehouse(name, version=lake_version)
         self._use_tick += 1
         e.last_use = self._use_tick
         if columns is None:
@@ -257,13 +354,38 @@ class Catalog:
             faults.maybe_fire(name)
         tracer = getattr(self.session, "tracer", None)
         t0 = _perf() if tracer is not None else 0.0
-        missing = [c for c in columns if c not in e.device_cols]
+        # capture THIS load's snapshot handle: a concurrent stream
+        # re-pinning the shared entry must not swap the manifest (or the
+        # column cache) out from under an in-flight read. When the
+        # captured pin does not match the PLAN's version (the entry was
+        # re-pinned between our pin attempt above and this capture), the
+        # load detaches: it resolves the plan's own snapshot and serves
+        # it without touching the entry cache at all — cached columns
+        # belong to the other pin now.
+        snap = e.pinned_snapshot
+        detached = (
+            e.fmt == "lakehouse"
+            and lake_version is not None
+            and (snap is None or snap.version != lake_version)
+        )
+        if detached:
+            from ..lakehouse.table import LakehouseTable
+
+            snap = LakehouseTable(
+                e.path, conf=self.session.conf
+            ).snapshot(lake_version)
+        missing = (
+            list(columns) if detached
+            else [c for c in columns if c not in e.device_cols]
+        )
         if missing:
 
             def _load(cols_to_load):
                 arrow = e.arrow
                 if arrow is None:
-                    arrow = self._dataset(e).to_table(columns=cols_to_load)
+                    arrow = self._dataset(e, snapshot=snap).to_table(
+                        columns=cols_to_load
+                    )
                 else:
                     arrow = arrow.select(cols_to_load)
                 return self._to_device(name, arrow, e)
@@ -283,6 +405,26 @@ class Catalog:
                 self.session.notify_failure(
                     f"task retry: device memory exhausted loading {name!r}; "
                     f"dropped cached tables and reloaded"
+                )
+            if detached or (
+                snap is not None and e.pinned_snapshot is not snap
+            ):
+                # detached up front, or a concurrent stream re-pinned the
+                # entry mid-load: serve THIS plan's snapshot (reloading
+                # any columns that came from the entry cache, which now
+                # belongs to the other pin) and leave the cache alone —
+                # per-plan isolation without cross-version cache poisoning
+                if set(missing) != set(columns):
+                    t = _load(columns)
+                if tracer is not None:
+                    tracer.emit(
+                        "catalog_load", table=name, columns=len(columns),
+                        loaded=len(columns), rows=t.nrows,
+                        dur_ms=round((_perf() - t0) * 1000.0, 3),
+                        cache="miss",
+                    )
+                return Table(
+                    {c: t.columns[c] for c in columns}, t.nrows
                 )
             e.nrows = t.nrows
             e.device_cols.update(t.columns)
@@ -398,6 +540,15 @@ class Catalog:
             # DML may have broken (or restored) the declared PK; re-verify
             # on next load before any join trusts the uniqueness claim
             e.pk_verified = None
+            # drop the snapshot pin: the next statement re-resolves (and
+            # re-leases) the head at its own plan time
+            e.pinned_version = None
+            e.pinned_snapshot = None
+            if e.lease_id is not None:
+                from ..lakehouse.leases import LEASES
+
+                LEASES.release(e.lease_id)
+                e.lease_id = None
 
 
 class Result:
@@ -653,7 +804,13 @@ class Session:
 
     def register_lakehouse(self, name, path, schema=None):
         """Snapshot-manifest (ACID) table — the Iceberg/Delta-equivalent
-        warehouse format used by the Data Maintenance phase."""
+        warehouse format used by the Data Maintenance phase. Registration
+        runs the once-per-process crash-hygiene sweep: a previous CRASHED
+        writer's staged-but-uncommitted data files and torn manifest
+        temps are removed before any query reads the table."""
+        from ..lakehouse.table import sweep_table_at_session_start
+
+        sweep_table_at_session_start(path)
         self._catalog_changed()
         self.catalog.entries[name.lower()] = _Entry(
             schema=schema, path=path, fmt="lakehouse"
@@ -671,10 +828,16 @@ class Session:
         from ..io.fs import get_fs, join as fs_join
 
         fs, root = get_fs(data_root)
+        if fmt == "lakehouse":
+            from ..lakehouse.table import sweep_table_at_session_start
         for tname, schema in schemas.items():
             if fs.exists(posixpath.join(root, tname)):
+                path = fs_join(data_root, tname)
+                if fmt == "lakehouse":
+                    # session-start crash hygiene, once per process/table
+                    sweep_table_at_session_start(path)
                 self.catalog.entries[tname] = _Entry(
-                    schema=schema, path=fs_join(data_root, tname), fmt=fmt
+                    schema=schema, path=path, fmt=fmt
                 )
 
     def drop(self, name):
@@ -799,17 +962,39 @@ class Session:
             verify(plan, "final")
         return plan
 
+    def _pin_lake_scans(self, plan):
+        """Snapshot-isolate this statement: resolve each lakehouse scan's
+        manifest version ONCE at plan time, annotate the Scan nodes with
+        it (engine/exec.py threads the pin into catalog.load), and
+        register the pins as reader leases. A query that scans a table
+        twice — or re-executes after a device-OOM recovery wiped the
+        column cache — therefore reads ONE snapshot even while a
+        concurrent replace()/append() commits (Iceberg's snapshot
+        isolation, per statement)."""
+        if not any(
+            e.fmt == "lakehouse" for e in self.catalog.entries.values()
+        ):
+            return plan  # no lake tables registered: zero-cost path
+        pinned = {}
+        for n in P.walk_plan(plan):
+            if isinstance(n, P.Scan):
+                if n.table not in pinned:
+                    pinned[n.table] = self.catalog.pin_lakehouse(n.table)
+                if pinned[n.table] is not None:
+                    n.lake_version = pinned[n.table]
+        return plan
+
     def run_stmt(self, stmt) -> Optional[Result]:
         if isinstance(stmt, A.SelectStmt):
             binder = Binder(self.catalog)
             plan = self._finish_plan(binder.bind(stmt), binder.promotions)
-            return Result(self, plan)
+            return Result(self, self._pin_lake_scans(plan))
         if isinstance(stmt, A.CreateViewStmt):
             binder = Binder(self.catalog)
             plan = self._finish_plan(
                 binder.bind(stmt.query), binder.promotions
             )
-            arrow = Result(self, plan).collect()
+            arrow = Result(self, self._pin_lake_scans(plan)).collect()
             self.register_arrow(stmt.name, arrow)
             return None
         if isinstance(stmt, A.DropViewStmt):

@@ -30,6 +30,9 @@ Injection sites fired around the codebase:
     exec:<query_name>     executor root, inside the engine proper
     load:<table_name>     catalog device load of a registered table
     commit:<table_name>   lakehouse manifest commit
+    stage:<table_name>    lakehouse staged-data write (io/crash kinds only)
+    manifest:<table_name> lakehouse manifest read (io/crash kinds only)
+    vacuum:<table_name>   lakehouse vacuum delete (io/crash kinds only)
     <phase_name>          full_bench phase runner (e.g. power_test)
     any path substring    fs_open (fired via maybe_fire_path)
 
@@ -52,13 +55,14 @@ import time
 DEVICE_OOM = "device_oom"  # accelerator memory exhausted (recover + retry)
 HOST_OOM = "host_oom"  # host allocation failed (recover + retry)
 IO_TRANSIENT = "io_transient"  # flaky storage/network (backoff + retry)
+COMMIT_CONFLICT = "commit_conflict"  # OCC loser (re-run the transaction)
 TIMEOUT = "timeout"  # watchdog fired (no retry: likely hangs again)
 PLANNER = "planner"  # parse/bind/exec logic error (deterministic)
 DATA = "data"  # malformed input data (deterministic)
 UNKNOWN = "unknown"
 
 #: kinds a retry can plausibly fix; everything else fails fast
-RETRYABLE = frozenset({DEVICE_OOM, HOST_OOM, IO_TRANSIENT})
+RETRYABLE = frozenset({DEVICE_OOM, HOST_OOM, IO_TRANSIENT, COMMIT_CONFLICT})
 
 _DEVICE_OOM_PAT = ("RESOURCE_EXHAUSTED", "Out of memory allocating")
 _HOST_OOM_PAT = (
@@ -91,6 +95,13 @@ _IO_PAT = (
     # ladder's io_backoff_retry rung owns it
     "SpillIOError",
 )
+# CommitConflictError (lakehouse/table.py): an optimistic lakehouse commit
+# lost the publish race and could not rebase. The transaction never
+# published, so re-running it against the fresh head is safe — the report
+# ladder's commit_rebase_retry rung owns it (with jittered backoff). Checked
+# before DATA: the conflict is a LakehouseError subclass, but it is the one
+# lakehouse failure that is TRANSIENT, not deterministic.
+_COMMIT_PAT = ("CommitConflictError", "concurrent commit conflict")
 # PlanVerifyError: the static plan verifier (analysis/verifier.py) found a
 # structural invariant violation — deterministic, so the ladder fails fast.
 # PlanBudgetError: admission control (analysis/budget.py) refused the plan
@@ -128,6 +139,9 @@ def classify(err) -> str:
     for pat in _IO_PAT:
         if pat in text:
             return IO_TRANSIENT
+    for pat in _COMMIT_PAT:
+        if pat in text:
+            return COMMIT_CONFLICT
     for pat in _PLANNER_PAT:
         if pat in text:
             return PLANNER

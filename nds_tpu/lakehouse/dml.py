@@ -40,7 +40,9 @@ def _lake_table(session, name: str) -> LakehouseTable:
         raise LakehouseError(
             f"{name!r} is not a lakehouse table registered on this session"
         )
-    return LakehouseTable(entry.path)
+    # thread the session conf so the table's OCC commit loop and vacuum
+    # retention read the engine.lake_* knobs
+    return LakehouseTable(entry.path, conf=getattr(session, "conf", None))
 
 
 def run_dml(session, stmt):
@@ -90,10 +92,22 @@ def _run_insert(session, stmt: A.InsertStmt):
 
 def _run_delete(session, stmt: A.DeleteStmt):
     table = _lake_table(session, stmt.table)
-    before = table.dataset().count_rows()
+    # snapshot-isolated transaction: every read of this DELETE (the
+    # row count, the survivor scan — arrow or engine path) resolves ONE
+    # manifest version, so a commit racing the statement can't make the
+    # "before" count and the scanned rows disagree. The final replace()
+    # then aborts with CommitConflictError if the head moved (overwrite
+    # transactions never rebase — lakehouse/table.py conflict matrix).
+    # Pinning the catalog entry registers the READER LEASE for this
+    # snapshot's files up front, so a concurrent vacuum can't delete
+    # them mid-scan on ANY of the paths below.
+    snap = table.snapshot()
+    name = stmt.table.lower()
+    session.catalog.pin_lakehouse(name, version=snap.version)
+    before = snap.dataset().count_rows()
     if stmt.where is None:
         # DELETE FROM t -> truncate
-        target = table.schema()
+        target = snap.schema()
         if target is None:
             raise LakehouseError(f"{stmt.table}: table has no schema")
         version = table.replace(target.empty_table(), operation="delete")
@@ -107,7 +121,7 @@ def _run_delete(session, stmt: A.DeleteStmt):
         # materializes on host (at SF3000 a ranged fact DELETE would
         # otherwise round-trip billions of rows through one host's memory)
         keep = arrow_pred.is_null() | ~arrow_pred  # NULL predicate survives
-        scanner = table.dataset().scanner(filter=keep, batch_size=1 << 20)
+        scanner = snap.dataset().scanner(filter=keep, batch_size=1 << 20)
         deleted = 0
         version = None
 
@@ -124,15 +138,19 @@ def _run_delete(session, stmt: A.DeleteStmt):
         return DmlResult(deleted, version)
 
     # engine fallback for predicates the Arrow translator can't express:
-    # survivors are rows where the predicate is FALSE or NULL
+    # survivors are rows where the predicate is FALSE or NULL. The pin
+    # (registered above) is HELD so the nested SELECT (and any scalar
+    # subquery over the target) reads the same version the row count
+    # came from.
     keep = E.UnaryOp("not", E.Func("coalesce", (stmt.where, E.Lit(False))))
     query = A.SelectStmt(
         select_items=[("*", None)],
         from_items=[A.TableRef(stmt.table)],
         where=keep,
     )
-    survivors = session.run_stmt(query).collect()
-    target = table.schema()
+    with session.catalog.hold_pins([name]):
+        survivors = session.run_stmt(query).collect()
+    target = snap.schema()
     if target is not None:
         survivors = _cast_to_schema(survivors, target)
     version = table.replace(survivors, operation="delete")

@@ -1,0 +1,136 @@
+"""In-process reader-lease table: the snapshot pins vacuum must respect.
+
+Iceberg/Delta get reader safety from their catalogs: `expire_snapshots`
+never deletes a data file a live reader's snapshot still references,
+because readers resolve snapshots through the same catalog service
+(reference: nds/nds_maintenance.py:118-202 runs snapshot expiry against
+exactly such a catalog). This engine has no catalog service — readers pin
+manifest versions in-process (engine/session.py resolves each lake scan's
+version once at plan time), so the equivalent safety record lives here: a
+process-wide table of (table root, version, file list) leases with a TTL.
+
+`LakehouseTable.vacuum` consults `held_files` and never deletes a file a
+live lease covers; `expire_snapshots` keeps leased versions' manifests.
+Leases record the snapshot's FILE LIST at acquire time, so even a lease
+whose manifest has since been expired keeps protecting its files.
+
+The TTL (conf `engine.lake_lease_ttl_s` / env NDS_LAKE_LEASE_TTL_S,
+default 300 s) bounds leakage: a crashed or abandoned reader's lease
+expires instead of pinning files forever. Pins renew on re-resolution, so
+a healthy long query stream never loses its lease mid-run. The table is
+process-wide on purpose — concurrent streams (thread-mode throughput,
+the maintenance-under-load phase) share one lease table exactly like
+they share one fault registry; cross-process vacuum safety is the TTL's
+job (vacuum only races readers inside the maintenance window, and the
+reference's single-catalog deployments have the same process scope).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+from time import monotonic as _monotonic
+
+#: default reader-lease TTL in seconds (engine.lake_lease_ttl_s /
+#: NDS_LAKE_LEASE_TTL_S): long enough for any benchmarked query, short
+#: enough that a crashed reader never blocks vacuum for more than one
+#: maintenance window
+DEFAULT_LEASE_TTL_S = 300.0
+
+
+def resolve_lease_ttl(conf: dict | None = None) -> float:
+    v = None
+    if conf:
+        v = conf.get("engine.lake_lease_ttl_s")
+    if v is None:
+        v = os.environ.get("NDS_LAKE_LEASE_TTL_S")
+    try:
+        return max(float(v), 0.0) if v is not None and v != "" else (
+            DEFAULT_LEASE_TTL_S
+        )
+    except (TypeError, ValueError):
+        return DEFAULT_LEASE_TTL_S
+
+
+class ReaderLeases:
+    """Thread-safe lease table. Leases are cheap dict records; expired
+    entries are pruned lazily on every read/write, so an idle process
+    holds at most the leases of its last activity burst."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._leases = {}  # id -> {root, version, files, expires}
+
+    def acquire(self, root: str, version: int, files, ttl_s: float) -> int:
+        """Register a pin of `version` over `files` (manifest-relative
+        paths) of the table at `root`; returns the lease id."""
+        lease_id = next(self._ids)
+        rec = {
+            "root": str(root),
+            "version": int(version),
+            "files": frozenset(str(f) for f in files),
+            "expires": _monotonic() + float(ttl_s),
+        }
+        with self._lock:
+            self._prune(_monotonic())
+            self._leases[lease_id] = rec
+        return lease_id
+
+    def renew(self, lease_id: int, ttl_s: float) -> bool:
+        """Extend a live lease; False when it already expired/was released
+        (caller should re-acquire)."""
+        now = _monotonic()
+        with self._lock:
+            self._prune(now)
+            rec = self._leases.get(lease_id)
+            if rec is None:
+                return False
+            rec["expires"] = now + float(ttl_s)
+            return True
+
+    def release(self, lease_id: int) -> bool:
+        with self._lock:
+            return self._leases.pop(lease_id, None) is not None
+
+    def _prune(self, now: float):
+        dead = [i for i, r in self._leases.items() if r["expires"] <= now]
+        for i in dead:
+            del self._leases[i]
+
+    # -- vacuum-side reads -------------------------------------------------
+    def held_versions(self, root: str) -> set:
+        root = str(root)
+        with self._lock:
+            self._prune(_monotonic())
+            return {
+                r["version"] for r in self._leases.values()
+                if r["root"] == root
+            }
+
+    def held_files(self, root: str) -> set:
+        """Manifest-relative file paths any live lease on `root` covers."""
+        root = str(root)
+        out = set()
+        with self._lock:
+            self._prune(_monotonic())
+            for r in self._leases.values():
+                if r["root"] == root:
+                    out |= r["files"]
+        return out
+
+    def live_count(self, root: str | None = None) -> int:
+        with self._lock:
+            self._prune(_monotonic())
+            if root is None:
+                return len(self._leases)
+            root = str(root)
+            return sum(
+                1 for r in self._leases.values() if r["root"] == root
+            )
+
+
+#: the process-wide lease table (module singleton, like faults._registry):
+#: every session's pins and every table's vacuum meet here
+LEASES = ReaderLeases()

@@ -7,7 +7,7 @@ history, timestamp rollback — without a JVM catalog service. This layer
 provides them with immutable parquet data files plus a JSON manifest log:
 
     <table>/
-      data/part-<version>-<n>.parquet      (immutable)
+      data/part-<pid>-<n>.parquet          (immutable)
       _manifests/v000001.json ...          (one per snapshot)
 
 A snapshot lists the data files that constitute the table at that version.
@@ -15,6 +15,37 @@ Writers stage data files first, then commit by publishing the next manifest
 (create-exclusive), so readers always see a consistent snapshot. Rollback
 appends a new manifest replaying an older file list — history is never
 rewritten, matching Iceberg's rollback_to_timestamp semantics.
+
+Concurrency (the Iceberg optimistic-concurrency model, in-process scale):
+
+* **Snapshot-isolated reads** — `snapshot(version)` returns a TableSnapshot
+  read handle resolved ONCE; every read through it (dataset/schema/files)
+  sees exactly that manifest, immune to racing commits. The engine pins one
+  snapshot per query at plan time (engine/session.py) and registers the pin
+  in the process-wide reader-lease table (lakehouse/leases.py) so vacuum
+  can never delete a file under a live reader.
+* **OCC commit with rebase** — `_commit` claims the next version with a
+  create-exclusive publish. A loser whose transaction is append-only
+  (base = current head) REBASES: it re-reads the new head and retries with
+  the new base file list (bounded by `engine.lake_commit_retries`, jittered
+  backoff), so append/append conflicts converge with both row sets present.
+  A loser that replaces the file set (overwrite/delete/rollback/create)
+  aborts with CommitConflictError — its writes were derived from a snapshot
+  that is no longer the head — and the report ladder's `commit_rebase_retry`
+  rung re-runs the whole transaction against the fresh snapshot.
+* **Vacuum + crash hygiene** — `expire_snapshots` drops old manifests
+  (never the head, never a leased version); `vacuum` deletes data files
+  referenced by no retained manifest, no live reader lease, and no live
+  writer's in-flight stage. Staged files and manifest temps embed the
+  writer pid, so `sweep_orphans` (run once per process at session start)
+  can remove a crashed writer's staged-but-uncommitted files and torn
+  `.tmp-*` manifests without ever touching a live or foreign file — the
+  same pid-manifest pattern as engine/spill.py's pool sweep.
+
+Failure domain: `stage:<table>`, `manifest:<table>` and `vacuum:<table>`
+are io/crash fault-injection sites, and `commit:<table>` fires before the
+manifest publish (a crash there leaves staged orphans but a fully readable
+previous snapshot — the all-or-nothing guarantee).
 
 All IO routes through the fsspec seam (io/fs.py), so a table may live on a
 local path, memory:// (tests), or any cloud URL — the reference reaches
@@ -24,7 +55,9 @@ HDFS/S3/GS in every phase and a multi-host run needs a shared warehouse.
 from __future__ import annotations
 
 import json
+import os
 import posixpath
+import re
 import time
 import uuid
 
@@ -33,23 +66,171 @@ import pyarrow.dataset as pads
 import pyarrow.parquet as pq
 
 from ..io.fs import get_fs, put_if_absent
+from .leases import LEASES
 
 _MANIFEST_DIR = "_manifests"
 _DATA_DIR = "data"
+
+#: staged data files / manifest temps embed the writer pid so crash
+#: hygiene can liveness-check the owner (spill.py's pid-manifest pattern);
+#: pre-existing tables' `part-<hex>.parquet` files still read fine through
+#: their manifests — the sweep just never attributes (or touches) them
+_STAGED_RE = re.compile(r"^part-(\d+)-[0-9a-f]{12}\.parquet$")
+_TMP_MANIFEST_RE = re.compile(r"^\.tmp-(\d+)-[0-9a-f]+\.json$")
+_DATA_FILE_RE = re.compile(r"^part-[0-9a-f-]+\.parquet$")
+
+#: bounded rebase budget for append/append commit conflicts
+#: (conf `engine.lake_commit_retries` / env NDS_LAKE_COMMIT_RETRIES)
+DEFAULT_COMMIT_RETRIES = 5
+
+#: backoff base (seconds) between rebase attempts — full jitter via
+#: faults.backoff_delays; 0 makes tests deterministic
+COMMIT_BACKOFF_ENV = "NDS_LAKE_COMMIT_BACKOFF"
+
+#: test seam for the interleaving harness: when set, called as
+#: hook(table_basename, operation, version) right before every publish
+#: attempt — deterministic schedule control over commit points (barriers
+#: force two writers onto one version, or land a commit between a pinned
+#: reader's two scans). None in production: one attribute check per commit.
+_COMMIT_HOOK = None
 
 
 class LakehouseError(Exception):
     pass
 
 
+class CommitConflictError(LakehouseError):
+    """An optimistic commit lost the publish race and could not (or must
+    not) be rebased. Classified `commit_conflict` (faults._COMMIT_PAT):
+    the transaction never published, so re-running it against the fresh
+    head is safe — the report ladder's `commit_rebase_retry` rung does
+    exactly that with jittered backoff."""
+
+
+def resolve_commit_retries(conf: dict | None = None) -> int:
+    v = None
+    if conf:
+        v = conf.get("engine.lake_commit_retries")
+    if v is None:
+        v = os.environ.get("NDS_LAKE_COMMIT_RETRIES")
+    try:
+        return max(int(v), 0) if v is not None and v != "" else (
+            DEFAULT_COMMIT_RETRIES
+        )
+    except (TypeError, ValueError):
+        return DEFAULT_COMMIT_RETRIES
+
+
+def commit_backoff_base() -> float:
+    """Jittered-backoff base seconds for commit-conflict retries — the ONE
+    parse shared by the in-table rebase loop, the report ladder's
+    `commit_rebase_retry` rung, and maintenance's statement-level re-run."""
+    try:
+        return max(float(os.environ.get(COMMIT_BACKOFF_ENV, "0.05")), 0.0)
+    except ValueError:
+        return 0.05
+
+
+def resolve_conflict_retries() -> int:
+    """How many times an aborted overwrite TRANSACTION may re-run (env
+    NDS_LAKE_CONFLICT_RETRIES, default 2) — shared by the report ladder
+    and maintenance's statement-level retry (the rebase loop inside
+    `_commit` has its own budget, resolve_commit_retries)."""
+    try:
+        return max(
+            int(os.environ.get("NDS_LAKE_CONFLICT_RETRIES", "2")), 0
+        )
+    except ValueError:
+        return 2
+
+
+def _tracer():
+    # lazy import: the table layer must stay importable without obs, and
+    # the thread-local binding is how session-less layers find their
+    # stream's tracer (same pattern as faults.FaultRegistry.fire)
+    from ..obs import trace as _obs_trace
+
+    return _obs_trace.current()
+
+
+class TableSnapshot:
+    """Immutable read handle pinned at one manifest version. Every read
+    resolves against the captured manifest — never the (possibly moved)
+    table head — which is what makes a query scanning a table twice
+    mid-`replace()` see ONE consistent snapshot."""
+
+    def __init__(self, table: "LakehouseTable", manifest: dict):
+        self.table = table
+        self.manifest = manifest
+        self.version = int(manifest["version"])
+        self.timestamp_ms = int(manifest["timestamp_ms"])
+        self.operation = manifest.get("operation")
+
+    @property
+    def rel_files(self):
+        """Manifest-relative data file paths (the lease currency)."""
+        return list(self.manifest["files"])
+
+    def files(self):
+        return [
+            posixpath.join(self.table.root, f) for f in self.manifest["files"]
+        ]
+
+    def num_rows(self) -> int:
+        return self.manifest.get("num_rows", -1)
+
+    def schema(self) -> pa.Schema | None:
+        files = self.files()
+        if files:
+            with self.table.fs.open(files[0], "rb") as fh:
+                return pq.read_schema(fh)
+        if self.manifest.get("schema_hex"):
+            # an all-rows DELETE leaves zero data files; the manifest still
+            # carries the schema so the table stays readable
+            import pyarrow.ipc as ipc
+
+            return ipc.read_schema(
+                pa.BufferReader(bytes.fromhex(self.manifest["schema_hex"]))
+            )
+        return None
+
+    def dataset(self) -> pads.Dataset:
+        files = self.files()
+        if not files:
+            # empty snapshot: in-memory empty dataset over the stored schema
+            schema = self.schema()
+            if schema is None:
+                raise LakehouseError(
+                    f"{self.table.path}: empty table with no schema"
+                )
+            return pads.dataset(schema.empty_table())
+        return pads.dataset(files, format="parquet", filesystem=self.table.fs)
+
+
 class LakehouseTable:
-    def __init__(self, path: str):
+    def __init__(self, path: str, conf: dict | None = None):
         self.path = str(path)
+        self.conf = conf  # optional engine conf tier (commit/vacuum knobs)
         self.fs, self.root = get_fs(path)
         self.manifest_dir = posixpath.join(self.root, _MANIFEST_DIR)
         self.data_dir = posixpath.join(self.root, _DATA_DIR)
         if not self.fs.isdir(self.manifest_dir):
             raise LakehouseError(f"{path} is not a lakehouse table")
+
+    @property
+    def name(self) -> str:
+        return posixpath.basename(self.root)
+
+    def _is_local(self) -> bool:
+        """True for local-POSIX tables, where a pid embedded in a staged
+        file name can be liveness-checked. Remote/shared stores (s3, gs,
+        memory, ...) get the conservative path: never attribute by pid."""
+        proto = (
+            self.fs.protocol
+            if isinstance(self.fs.protocol, str)
+            else self.fs.protocol[0]
+        )
+        return proto in ("file", "local")
 
     # -- creation ----------------------------------------------------------
     @classmethod
@@ -64,7 +245,11 @@ class LakehouseTable:
         if schema is None and staged:
             with t.fs.open(posixpath.join(t.root, staged[0][0]), "rb") as fh:
                 schema = pq.read_schema(fh)
-        t._commit(staged, "create", base_files=[], schema=schema)
+        try:
+            t._commit(staged, "create", base_files=[], schema=schema)
+        except CommitConflictError:
+            t._discard_staged(staged)
+            raise
         return t
 
     @classmethod
@@ -73,18 +258,46 @@ class LakehouseTable:
         return fs.isdir(posixpath.join(root, _MANIFEST_DIR))
 
     # -- snapshot log ------------------------------------------------------
+    def _version_numbers(self):
+        """Snapshot version numbers ascending, from manifest FILENAMES
+        alone (v%06d.json encodes the version) — no manifest is opened,
+        so head resolution stays O(1 listing) however long the history
+        grows (per-statement pins would otherwise read every manifest)."""
+        out = []
+        for f in self.fs.ls(self.manifest_dir, detail=False):
+            name = posixpath.basename(f)
+            if name.startswith("v") and name.endswith(".json"):
+                try:
+                    out.append(int(name[1:-5]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
     def versions(self):
-        """[(version, timestamp_ms, operation)] ascending."""
+        """[(version, timestamp_ms, operation)] ascending. Tolerates a
+        manifest vanishing between the listing and the read: a concurrent
+        `expire_snapshots` (the maintenance-under-load phase runs vacuum
+        WHILE streams re-resolve heads) deletes old manifests, and a
+        reader racing it must see the post-expiry log, not crash."""
         out = []
         for f in sorted(self.fs.ls(self.manifest_dir, detail=False)):
             name = posixpath.basename(f)
             if name.startswith("v") and name.endswith(".json"):
-                with self.fs.open(f, "r") as fh:
-                    m = json.load(fh)
+                try:
+                    with self.fs.open(f, "r") as fh:
+                        m = json.load(fh)
+                except FileNotFoundError:
+                    continue  # expired under us: same as never listed
                 out.append((m["version"], m["timestamp_ms"], m["operation"]))
         return out
 
     def _manifest(self, version: int) -> dict:
+        from .. import faults
+
+        if faults.active():
+            # io/crash injection site for manifest reads: a flaky store
+            # failing a head re-read mid-rebase must walk the io ladder
+            faults.maybe_fire(f"manifest:{self.name}", kinds=("io", "crash"))
         p = posixpath.join(self.manifest_dir, f"v{version:06d}.json")
         try:
             with self.fs.open(p, "r") as fh:
@@ -93,49 +306,41 @@ class LakehouseTable:
             raise LakehouseError(f"{self.path}: no snapshot v{version}")
 
     def current_version(self) -> int:
-        vs = [v for v, _, _ in self.versions()]
+        vs = self._version_numbers()
         if not vs:
             raise LakehouseError(f"{self.path}: no snapshots")
         return max(vs)
 
+    def snapshot(self, version: int | None = None) -> TableSnapshot:
+        """Pinned read handle: resolve (current or explicit) version ONCE;
+        all reads through the handle see exactly that manifest."""
+        if version is None:
+            version = self.current_version()
+        return TableSnapshot(self, self._manifest(version))
+
     def current_files(self):
-        m = self._manifest(self.current_version())
-        return [posixpath.join(self.root, f) for f in m["files"]]
+        return self.snapshot().files()
 
     def num_rows(self) -> int:
-        m = self._manifest(self.current_version())
-        return m.get("num_rows", -1)
+        return self.snapshot().num_rows()
 
     # -- reads -------------------------------------------------------------
     def dataset(self) -> pads.Dataset:
-        files = self.current_files()
-        if not files:
-            # empty snapshot: in-memory empty dataset over the stored schema
-            schema = self.schema()
-            if schema is None:
-                raise LakehouseError(f"{self.path}: empty table with no schema")
-            return pads.dataset(schema.empty_table())
-        return pads.dataset(files, format="parquet", filesystem=self.fs)
+        return self.snapshot().dataset()
 
     def schema(self) -> pa.Schema | None:
-        files = self.current_files()
-        if files:
-            with self.fs.open(files[0], "rb") as fh:
-                return pq.read_schema(fh)
-        m = self._manifest(self.current_version())
-        if m.get("schema_hex"):
-            # an all-rows DELETE leaves zero data files; the manifest still
-            # carries the schema so the table stays readable
-            import pyarrow.ipc as ipc
-
-            return ipc.read_schema(
-                pa.BufferReader(bytes.fromhex(m["schema_hex"]))
-            )
-        return None
+        return self.snapshot().schema()
 
     # -- writes ------------------------------------------------------------
     def _stage(self, batches, schema=None):
-        """Write data files; returns [(relpath, num_rows)]. Not yet visible."""
+        """Write data files; returns [(relpath, num_rows)]. Not yet visible.
+        File names embed this process's pid (crash-hygiene attribution)."""
+        from .. import faults
+
+        if faults.active():
+            # io/crash injection site for staged-data writes: a crash here
+            # leaves orphaned data files and NO manifest — the sweep's food
+            faults.maybe_fire(f"stage:{self.name}", kinds=("io", "crash"))
         if isinstance(batches, pa.Table):
             batches = batches.to_batches(max_chunksize=1 << 20)
         staged = []
@@ -147,7 +352,8 @@ class LakehouseTable:
             for b in batches:
                 if writer is None:
                     relpath = posixpath.join(
-                        _DATA_DIR, f"part-{uuid.uuid4().hex[:12]}.parquet"
+                        _DATA_DIR,
+                        f"part-{os.getpid()}-{uuid.uuid4().hex[:12]}.parquet",
                     )
                     out = self.fs.open(
                         posixpath.join(self.root, relpath), "wb"
@@ -166,8 +372,27 @@ class LakehouseTable:
             staged.append((relpath, n_rows))
         return staged
 
-    def _commit(self, staged, operation, base_files=None, num_rows=None, schema=None):
-        """Append the next manifest: base file list + staged files."""
+    def _discard_staged(self, staged):
+        """Best-effort cleanup of staged files after an aborted commit (the
+        orphan sweep is the backstop for anything missed)."""
+        for rel, _ in staged:
+            try:
+                self.fs.rm_file(posixpath.join(self.root, rel))
+            except OSError:
+                pass
+
+    def _commit(self, staged, operation, base_files=None, num_rows=None,
+                schema=None):
+        """Publish the next manifest: base file list + staged files.
+
+        Optimistic concurrency with bounded rebase: each attempt reads the
+        head, claims head+1 with a create-exclusive publish, and on losing
+        the race either REBASES (base_files is None — the transaction is
+        append-only, so replaying it onto the new head's file list is
+        exactly Iceberg's fast-append retry) or ABORTS with
+        CommitConflictError (an explicit base file list means the writes
+        were derived from a snapshot that is no longer the head; publishing
+        would silently drop the winner's rows)."""
         from .. import faults
 
         if faults.active():
@@ -175,66 +400,116 @@ class LakehouseTable:
             # manifest publish, so staged data files exist but no snapshot
             # references them — proving commits are all-or-nothing under
             # io/crash faults (Iceberg's commit-point guarantee)
-            faults.maybe_fire(f"commit:{posixpath.basename(self.root)}")
+            faults.maybe_fire(f"commit:{self.name}")
             faults.maybe_fire_path(self.root)
         schema_hex = None
         if schema is not None:
             schema_hex = bytes(schema.serialize()).hex()
-        try:
-            cur = self._manifest(self.current_version())
-            version = cur["version"] + 1
-            base = cur["files"] if base_files is None else base_files
-            base_rows = cur.get("num_rows", 0) if base_files is None else 0
-            prev_ts = cur["timestamp_ms"]
-            if schema_hex is None:
-                schema_hex = cur.get("schema_hex")
-        except LakehouseError:
-            version, base, base_rows, prev_ts = 1, base_files or [], 0, 0
-        files = list(base) + [p for p, _ in staged]
-        total = (
-            num_rows
-            if num_rows is not None
-            else base_rows + sum(n for _, n in staged)
-        )
-        manifest = {
-            "version": version,
-            # strictly monotonic so timestamp rollback can never tie between
-            # adjacent snapshots (Iceberg has the same guarantee)
-            "timestamp_ms": max(int(time.time() * 1000), prev_ts + 1),
-            "operation": operation,
-            "files": files,
-            "num_rows": total,
-            "schema_hex": schema_hex,
-        }
-        tmp = posixpath.join(self.manifest_dir, f".tmp-{uuid.uuid4().hex}.json")
-        with self.fs.open(tmp, "w") as fh:
-            json.dump(manifest, fh)
-        # optimistic concurrency: publish is create-exclusive, so a
-        # concurrent writer that claimed the same version fails loudly
-        # instead of silently last-writer-winning (Iceberg's
-        # commit-conflict guarantee; see io/fs.py put_if_absent for the
-        # local-atomic vs remote-best-effort split)
-        dest = posixpath.join(self.manifest_dir, f"v{version:06d}.json")
-        if not put_if_absent(self.fs, tmp, dest):
-            raise LakehouseError(
-                f"{self.path}: concurrent commit conflict at version "
-                f"{version}; retry the transaction"
+        retries = resolve_commit_retries(self.conf)
+        delays = faults.backoff_delays(retries, commit_backoff_base())
+        attempts = 0
+        while True:
+            attempts += 1
+            try:
+                cur = self._manifest(self.current_version())
+                version = cur["version"] + 1
+                base = cur["files"] if base_files is None else base_files
+                base_rows = (
+                    cur.get("num_rows", 0) if base_files is None else 0
+                )
+                prev_ts = cur["timestamp_ms"]
+                if schema_hex is None:
+                    schema_hex = cur.get("schema_hex")
+            except LakehouseError:
+                version, base, base_rows, prev_ts = 1, base_files or [], 0, 0
+            files = list(base) + [p for p, _ in staged]
+            total = (
+                num_rows
+                if num_rows is not None
+                else base_rows + sum(n for _, n in staged)
             )
-        return version
+            manifest = {
+                "version": version,
+                # strictly monotonic so timestamp rollback can never tie
+                # between adjacent snapshots (Iceberg's same guarantee)
+                "timestamp_ms": max(int(time.time() * 1000), prev_ts + 1),
+                "operation": operation,
+                "files": files,
+                "num_rows": total,
+                "schema_hex": schema_hex,
+            }
+            if _COMMIT_HOOK is not None:
+                _COMMIT_HOOK(self.name, operation, version)
+            tmp = posixpath.join(
+                self.manifest_dir,
+                f".tmp-{os.getpid()}-{uuid.uuid4().hex}.json",
+            )
+            with self.fs.open(tmp, "w") as fh:
+                json.dump(manifest, fh)
+            # optimistic concurrency: publish is create-exclusive, so a
+            # concurrent writer that claimed the same version fails loudly
+            # instead of silently last-writer-winning (Iceberg's
+            # commit-conflict guarantee; see io/fs.py put_if_absent for the
+            # local-atomic vs remote-best-effort split)
+            dest = posixpath.join(self.manifest_dir, f"v{version:06d}.json")
+            if put_if_absent(self.fs, tmp, dest):
+                tracer = _tracer()
+                if tracer is not None:
+                    tracer.emit(
+                        "lake_commit", table=self.name, operation=operation,
+                        version=version, attempts=attempts,
+                        rebased=attempts > 1,
+                    )
+                return version
+            # lost the race. Overwrite-style transactions (explicit base
+            # file list) abort: their writes no longer describe the head.
+            delay = (
+                next(delays, None) if base_files is None else None
+            )
+            if delay is None:
+                tracer = _tracer()
+                if tracer is not None:
+                    tracer.emit(
+                        "lake_commit", table=self.name, operation=operation,
+                        version=version, attempts=attempts, conflict=True,
+                    )
+                why = (
+                    f"rebase budget ({retries}) exhausted"
+                    if base_files is None
+                    else "overwrite transactions cannot rebase"
+                )
+                raise CommitConflictError(
+                    f"{self.path}: concurrent commit conflict at version "
+                    f"{version} after {attempts} attempt(s) ({why}); "
+                    f"retry the transaction"
+                )
+            if delay:
+                time.sleep(delay)
 
     def append(self, table, operation="append") -> int:
         """INSERT: add rows (pa.Table or batch iterable) as new immutable
-        files; returns the new version."""
+        files; returns the new version. Concurrent appends converge via
+        commit rebase (both row sets present)."""
         staged = self._stage(table)
-        return self._commit(staged, operation)
+        try:
+            return self._commit(staged, operation)
+        except CommitConflictError:
+            self._discard_staged(staged)
+            raise
 
     def replace(self, table: pa.Table, operation="overwrite") -> int:
-        """Replace the full file set (copy-on-write DELETE/UPDATE)."""
+        """Replace the full file set (copy-on-write DELETE/UPDATE). Aborts
+        on ANY concurrent commit — the replacement rows were derived from a
+        snapshot that is no longer the head."""
         staged = self._stage(table)
-        return self._commit(
-            staged, operation, base_files=[],
-            num_rows=sum(n for _, n in staged),
-        )
+        try:
+            return self._commit(
+                staged, operation, base_files=[],
+                num_rows=sum(n for _, n in staged),
+            )
+        except CommitConflictError:
+            self._discard_staged(staged)
+            raise
 
     # -- time travel -------------------------------------------------------
     def rollback_to_version(self, version: int) -> int:
@@ -246,10 +521,243 @@ class LakehouseTable:
 
     def rollback_to_timestamp(self, ts_ms: int) -> int:
         """Roll back to the last snapshot at or before ts_ms (reference:
-        CALL spark_catalog.system.rollback_to_timestamp, nds_rollback.py:46-51)."""
+        CALL spark_catalog.system.rollback_to_timestamp, nds_rollback.py:46-51).
+        A ts_ms exactly equal to a snapshot's (strictly monotonic)
+        timestamp selects that snapshot."""
         candidates = [v for v, t, _ in self.versions() if t <= ts_ms]
         if not candidates:
             raise LakehouseError(
                 f"{self.path}: no snapshot at or before {ts_ms}"
             )
         return self.rollback_to_version(max(candidates))
+
+    # -- maintenance: snapshot expiry + vacuum -----------------------------
+    def _retain_last(self, retain_last) -> int:
+        if retain_last is None and self.conf:
+            retain_last = self.conf.get("engine.lake_vacuum_retain")
+        if retain_last is None:
+            retain_last = os.environ.get("NDS_LAKE_VACUUM_RETAIN")
+        try:
+            return max(int(retain_last), 1) if retain_last else 2
+        except (TypeError, ValueError):
+            return 2
+
+    def expire_snapshots(self, retain_last=None, older_than_ms=None):
+        """Drop old manifests (Iceberg's expire_snapshots). The head and
+        the newest `retain_last` versions always survive, as does any
+        version a live reader lease pins (its manifest stays resolvable
+        for rollback while the reader works; the lease's own FILE list
+        protects data either way). Returns the expired version numbers."""
+        vs = self.versions()
+        retain_last = self._retain_last(retain_last)
+        keep = {v for v, _, _ in vs[-retain_last:]}
+        leased = LEASES.held_versions(self.root)
+        expired = []
+        for v, ts, _ in vs:
+            if v in keep or v in leased:
+                continue
+            if older_than_ms is not None and ts > older_than_ms:
+                continue
+            try:
+                self.fs.rm_file(
+                    posixpath.join(self.manifest_dir, f"v{v:06d}.json")
+                )
+            except OSError:
+                continue  # already gone / transient: next vacuum retries
+            expired.append(v)
+        return expired
+
+    def vacuum(self, retain_last=None, older_than_ms=None) -> dict:
+        """Expire old snapshots, then delete data files that no retained
+        manifest references — EXCEPT files covered by a live reader lease
+        (a pinned query may still be scanning an expired snapshot) or
+        staged by a live writer pid (an in-flight commit's files are not
+        orphans). Crash-safe by ordering: manifests are removed before
+        their files, so an interrupted vacuum leaves only sweepable
+        unreferenced files, never a manifest pointing at deleted data."""
+        from .. import faults
+
+        if faults.active():
+            # io/crash injection site: a crash mid-vacuum must never lose
+            # a committed snapshot (retained manifests + their files are
+            # untouched by construction)
+            faults.maybe_fire(f"vacuum:{self.name}", kinds=("io", "crash"))
+        # capture the pre-expiry referenced set FIRST: a file some manifest
+        # references was committed, so once its manifest expires it is
+        # collectable even though its writer pid is still alive — the
+        # live-pid guard below is only for never-referenced in-flight
+        # stages (a commit racing this vacuum)
+        committed = self._all_referenced_files()
+        expired = self.expire_snapshots(retain_last, older_than_ms)
+        referenced = self._all_referenced_files()
+        leased = LEASES.held_files(self.root)
+        removed, leased_kept, bytes_removed = [], 0, 0
+        try:
+            entries = self.fs.ls(self.data_dir, detail=True)
+        except OSError:
+            entries = []
+        # re-read the manifest log AFTER listing the data dir: a commit
+        # that published between the first referenced-set read and the
+        # listing (a racing writer that then exited, defeating the
+        # pid-liveness guard) must land in `referenced` before anything
+        # is deleted. The residual publish-vs-unlink window is the same
+        # one Iceberg closes with a catalog service; single-process
+        # maintenance windows (the shipped harnesses) never race it.
+        referenced |= self._all_referenced_files()
+        for ent in entries:
+            full = ent["name"] if isinstance(ent, dict) else str(ent)
+            base = posixpath.basename(full)
+            if not _DATA_FILE_RE.match(base):
+                continue  # never touch files outside our naming scheme
+            rel = posixpath.join(_DATA_DIR, base)
+            if rel in referenced:
+                continue
+            if rel in leased:
+                leased_kept += 1
+                continue
+            m = _STAGED_RE.match(base)
+            if (
+                rel not in committed
+                and m is not None
+                and (not self._is_local() or _pid_alive(int(m.group(1))))
+            ):
+                # a writer's in-flight stage, not an orphan. Pid liveness
+                # is host-local, so on a REMOTE (shared) warehouse every
+                # never-referenced stage is protected unconditionally —
+                # deleting a live remote writer's stage would corrupt the
+                # commit it is about to publish.
+                continue
+            if faults.active():
+                faults.maybe_fire_path(full)
+            try:
+                self.fs.rm_file(posixpath.join(self.data_dir, base))
+            except OSError:
+                continue
+            removed.append(rel)
+            if isinstance(ent, dict):
+                bytes_removed += int(ent.get("size") or 0)
+        tracer = _tracer()
+        if tracer is not None:
+            tracer.emit(
+                "lake_vacuum", table=self.name, files_removed=len(removed),
+                manifests_removed=len(expired), files_leased=leased_kept,
+                bytes_removed=bytes_removed,
+            )
+        return {
+            "table": self.name,
+            "files_removed": len(removed),
+            "manifests_removed": len(expired),
+            "files_leased": leased_kept,
+            "bytes_removed": bytes_removed,
+            "removed": removed,
+            "expired_versions": expired,
+        }
+
+    def _all_referenced_files(self) -> set:
+        """Union of every live manifest's file list; a manifest expiring
+        between the listing and its read is skipped (post-expiry view)."""
+        out = set()
+        for v in self._version_numbers():
+            try:
+                out.update(self._manifest(v)["files"])
+            except LakehouseError:
+                continue  # expired under us
+        return out
+
+    # -- crash hygiene: orphaned-stage sweep -------------------------------
+    def sweep_orphans(self) -> int:
+        """Remove a crashed writer's leavings: staged data files that no
+        manifest references and whose embedded writer pid is dead, plus
+        torn `.tmp-<pid>-*.json` manifest temps with dead pids. Files the
+        naming scheme cannot attribute (foreign files, pre-pid-format
+        parts) are never touched — the same never-touch-foreign contract
+        as spill.sweep_orphans. Pid liveness is host-local, so on a
+        REMOTE (shared) warehouse the sweep is a no-op — a live writer on
+        another host would read as dead and lose its in-flight stage;
+        remote deployments clean orphans through vacuum's referenced-set
+        path instead. Returns the number of files removed."""
+        if not self._is_local():
+            return 0
+        referenced = self._all_referenced_files()
+        removed = 0
+        try:
+            data_names = [
+                posixpath.basename(f)
+                for f in self.fs.ls(self.data_dir, detail=False)
+            ]
+        except OSError:
+            data_names = []
+        for base in data_names:
+            m = _STAGED_RE.match(base)
+            if m is None:
+                continue
+            if posixpath.join(_DATA_DIR, base) in referenced:
+                continue
+            pid = int(m.group(1))
+            if pid == os.getpid() or _pid_alive(pid):
+                continue
+            try:
+                self.fs.rm_file(posixpath.join(self.data_dir, base))
+                removed += 1
+            except OSError:
+                pass
+        try:
+            man_names = [
+                posixpath.basename(f)
+                for f in self.fs.ls(self.manifest_dir, detail=False)
+            ]
+        except OSError:
+            man_names = []
+        for base in man_names:
+            m = _TMP_MANIFEST_RE.match(base)
+            if m is None:
+                continue
+            pid = int(m.group(1))
+            if pid == os.getpid() or _pid_alive(pid):
+                continue
+            try:
+                self.fs.rm_file(posixpath.join(self.manifest_dir, base))
+                removed += 1
+            except OSError:
+                pass
+        if removed:
+            print(
+                f"lakehouse: swept {removed} orphaned file(s) from "
+                f"{self.path}"
+            )
+        return removed
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except (PermissionError, OSError):
+        return True  # exists but owned elsewhere: treat as alive
+    return True
+
+
+# one sweep per (process, table root): sessions are per-stream in
+# throughput runs, and re-listing every table per session buys nothing.
+# Process-lifetime once-latch; worst case under a race is a second,
+# idempotent sweep.
+# nds-lint: disable=mutable-module-global
+_SWEPT_TABLES = set()
+
+
+def sweep_table_at_session_start(path: str):
+    """Session-start crash hygiene for one lakehouse table (called by the
+    catalog when a lakehouse entry is registered): remove a dead writer's
+    staged-but-uncommitted data files and torn manifest temps, once per
+    process per table."""
+    key = str(path)
+    if key in _SWEPT_TABLES:
+        return 0
+    _SWEPT_TABLES.add(key)
+    try:
+        if not LakehouseTable.is_table(path):
+            return 0
+        return LakehouseTable(path).sweep_orphans()
+    except Exception:
+        return 0  # hygiene is best-effort; never block a session build

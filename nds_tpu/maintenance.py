@@ -11,7 +11,9 @@ straight from the generated `--update` CSV data.
 from __future__ import annotations
 
 import csv
+import math
 import os
+import threading
 import time
 from datetime import datetime
 
@@ -19,6 +21,7 @@ from . import faults
 from .check import check_json_summary_folder
 from .engine.session import Session
 from .io.fs import fs_open_atomic
+from .obs import trace as obs_trace
 from .power import load_properties
 from .report import BenchReport
 from .schema import get_maintenance_schemas, get_schemas
@@ -95,7 +98,42 @@ def run_dm_query(session, query_list, query_name):
     # with the refresh function, exactly like power's per-query scope
     with faults.scope(query_name):
         for q in query_list:
-            session.run_script(q)
+            _run_dm_statement(session, q)
+
+
+def _run_dm_statement(session, q):
+    """One refresh statement with bounded commit-conflict re-runs.
+
+    The retry has to live at STATEMENT granularity: a DM function is a
+    list of statements, and re-running the whole function after its Nth
+    statement's commit aborted would double-apply statements 1..N-1. A
+    single aborted statement published nothing (staged files discarded),
+    so re-running it re-derives its writes from the fresh head — the
+    same semantics the report ladder's `commit_rebase_retry` rung gives
+    idempotent whole-query callables. Budget/backoff share the ladder's
+    knobs (NDS_LAKE_CONFLICT_RETRIES / NDS_LAKE_COMMIT_BACKOFF), parsed
+    in their one home: lakehouse/table.py."""
+    from .lakehouse.table import (
+        CommitConflictError,
+        commit_backoff_base,
+        resolve_conflict_retries,
+    )
+
+    delays = faults.backoff_delays(
+        resolve_conflict_retries(), commit_backoff_base()
+    )
+    while True:
+        try:
+            return session.run_script(q)
+        except CommitConflictError as exc:
+            delay = next(delays, None)
+            if delay is None:
+                raise
+            print(
+                f"maintenance: commit conflict ({exc}); re-running the "
+                f"statement against the new head in {delay:.2f}s"
+            )
+            time.sleep(delay)
 
 
 # staging tables each refresh function reads (spec 5.3.11); the delete-date
@@ -132,6 +170,41 @@ def register_refresh_views(session, refresh_data_path, valid_queries=None):
         session.register_csv_dir(table, path, schemas[table])
 
 
+def vacuum_warehouse(warehouse_path, tables=None, retain_last=None,
+                     conf=None):
+    """Expire old snapshots and delete unreferenced data files across the
+    warehouse's lakehouse tables (Iceberg's expire_snapshots + orphan
+    cleanup). Files a live reader lease covers are never deleted —
+    vacuum can run while query streams are mid-flight (the
+    maintenance-under-load phase does exactly that). Returns the
+    per-table vacuum result dicts."""
+    from .lakehouse.table import LakehouseTable
+
+    results = []
+    names = tables
+    if names is None:
+        try:
+            names = sorted(os.listdir(warehouse_path))
+        except OSError:
+            names = []
+    for name in names:
+        path = os.path.join(str(warehouse_path), name)
+        if not LakehouseTable.is_table(path):
+            continue
+        res = LakehouseTable(path, conf=conf).vacuum(retain_last=retain_last)
+        if res["files_removed"] or res["manifests_removed"]:
+            print(
+                f"vacuum {name}: removed {res['files_removed']} data "
+                f"file(s), {res['manifests_removed']} manifest(s)"
+                + (
+                    f", kept {res['files_leased']} leased file(s)"
+                    if res["files_leased"] else ""
+                )
+            )
+        results.append(res)
+    return results
+
+
 def run_maintenance(
     warehouse_path,
     refresh_data_path,
@@ -141,10 +214,14 @@ def run_maintenance(
     spec_queries=None,
     use_decimal=True,
     maintenance_sql_dir=None,
+    vacuum_after=False,
 ):
     """Run the maintenance functions with per-function timing + reports.
 
-    Returns the Data Maintenance Time in seconds (Tdm contribution)."""
+    Returns the Data Maintenance Time in seconds (Tdm contribution).
+    `vacuum_after` additionally expires old snapshots + sweeps
+    unreferenced data files once the functions complete (retention:
+    `engine.lake_vacuum_retain` / NDS_LAKE_VACUUM_RETAIN, default 2)."""
     valid_queries = get_valid_query_names(spec_queries)
     app_name = (
         "NDS - Data Maintenance - " + valid_queries[0]
@@ -156,6 +233,26 @@ def run_maintenance(
         conf.update(load_properties(property_file))
     check_json_summary_folder(json_summary_folder)
     session = Session(use_decimal=use_decimal, conf=conf)
+    try:
+        return _run_maintenance_body(
+            session, warehouse_path, refresh_data_path,
+            time_log_output_path, json_summary_folder, property_file,
+            valid_queries, maintenance_sql_dir, vacuum_after,
+        )
+    finally:
+        # this maintenance run is its tracer's ONLY emitter: closing here
+        # (success or crash) flushes the final line so a child dying
+        # mid-phase folds cleanly into the parent's event view — the same
+        # contract as power.run_query_stream (PR-8)
+        if session.tracer is not None:
+            session.tracer.close()
+
+
+def _run_maintenance_body(
+    session, warehouse_path, refresh_data_path, time_log_output_path,
+    json_summary_folder, property_file, valid_queries, maintenance_sql_dir,
+    vacuum_after,
+):
     app_id = f"nds-tpu-dm-{os.getpid()}-{int(time.time())}"
 
     # warehouse fact/dim tables (lakehouse) + refresh staging views (csv)
@@ -169,23 +266,38 @@ def run_maintenance(
     execution_time_list = []
     total_time_start = datetime.now()
     dm_start = datetime.now()
-    for query_name, q_content in query_dict.items():
-        print(f"====== Run {query_name} ======")
-        q_report = BenchReport(session)
-        summary = q_report.report_on(
-            run_dm_query, session, q_content, query_name, name=query_name
-        )
-        print(f"Time taken: {summary['queryTimes']} millis for {query_name}")
-        execution_time_list.append((app_id, query_name, summary["queryTimes"]))
-        if json_summary_folder:
-            if property_file:
-                summary_prefix = os.path.join(
-                    json_summary_folder,
-                    os.path.basename(property_file).split(".")[0],
-                )
-            else:
-                summary_prefix = os.path.join(json_summary_folder, "")
-            q_report.write_summary(query_name, prefix=summary_prefix)
+    # bind the session tracer to this thread: session-less layers (the
+    # lakehouse commit/vacuum event sites, fault registry, fs retries)
+    # find it through the thread-local binding
+    with obs_trace.bind(session.tracer):
+        for query_name, q_content in query_dict.items():
+            print(f"====== Run {query_name} ======")
+            q_report = BenchReport(session)
+            summary = q_report.report_on(
+                run_dm_query, session, q_content, query_name, name=query_name
+            )
+            print(
+                f"Time taken: {summary['queryTimes']} millis for {query_name}"
+            )
+            execution_time_list.append(
+                (app_id, query_name, summary["queryTimes"])
+            )
+            if json_summary_folder:
+                if property_file:
+                    summary_prefix = os.path.join(
+                        json_summary_folder,
+                        os.path.basename(property_file).split(".")[0],
+                    )
+                else:
+                    summary_prefix = os.path.join(json_summary_folder, "")
+                q_report.write_summary(query_name, prefix=summary_prefix)
+        if vacuum_after:
+            v_start = time.perf_counter()
+            vacuum_warehouse(warehouse_path, conf=session.conf)
+            execution_time_list.append(
+                (app_id, "Vacuum Time",
+                 round(time.perf_counter() - v_start, 3))
+            )
     dm_end = datetime.now()
     dm_elapse = (dm_end - dm_start).total_seconds()
     total_elapse = (dm_end - total_time_start).total_seconds()
@@ -207,6 +319,207 @@ def run_maintenance(
         writer.writerow(header)
         writer.writerows(execution_time_list)
     return dm_elapse
+
+
+# ---------------------------------------------------------------------------
+# maintenance under load: DM_* commits racing a live query stream
+# ---------------------------------------------------------------------------
+
+
+def _p99_ms(times):
+    """p99 of a list of per-query milliseconds (nearest-rank); None when
+    empty. Small streams degenerate to the max — the right tail either way."""
+    if not times:
+        return None
+    ts = sorted(times)
+    idx = max(int(math.ceil(0.99 * len(ts))) - 1, 0)
+    return round(float(ts[idx]), 3)
+
+
+def run_maintenance_under_load(
+    warehouse_path,
+    refresh_data_path,
+    stream_file,
+    time_log_output_path,
+    report_path=None,
+    property_file=None,
+    spec_queries=None,
+    sub_queries=None,
+    use_decimal=True,
+    vacuum_retain=None,
+):
+    """Maintenance-under-load: DM_* refresh functions (and a vacuum)
+    commit against the warehouse WHILE a query stream reads it — the
+    scenario the reference gets exercised for free by Spark+Iceberg
+    concurrency and this engine previously never ran (full_bench
+    serialized maintenance against query streams; ROADMAP item 5).
+
+    Two passes over the stream: a SOLO baseline, then the same stream
+    with the maintenance thread racing it. Reported as maintenance
+    throughput (functions/s) x query p99 degradation (under-load p99 /
+    solo p99). Snapshot pins keep every in-flight query on one manifest
+    version across the racing commits; the concurrent vacuum respects
+    the readers' leases. Returns the report dict (also written to
+    `report_path` atomically when given)."""
+    from .power import gen_sql_from_stream, get_query_subset, run_one_query
+
+    valid_queries = get_valid_query_names(spec_queries)
+    conf = {
+        "app.name": "NDS - Maintenance Under Load",
+        "lakehouse.warehouse": warehouse_path,
+    }
+    if property_file:
+        conf.update(load_properties(property_file))
+    query_dict = gen_sql_from_stream(stream_file)
+    if sub_queries:
+        query_dict = get_query_subset(query_dict, sub_queries)
+    app_id = f"nds-tpu-mul-{os.getpid()}-{int(time.time())}"
+
+    # reader and writer run on SEPARATE sessions (each with its own
+    # snapshot pins and tracer) but share the process-wide reader-lease
+    # table — which is exactly what makes the writer's vacuum safe while
+    # the reader is mid-query
+    qconf = dict(conf)
+    qconf["app.name"] = "NDS - MUL query stream"
+    qsession = Session(use_decimal=use_decimal, conf=qconf)
+    msession = Session(use_decimal=use_decimal, conf=dict(conf))
+    try:
+        qsession.register_nds_tables(warehouse_path, fmt="lakehouse")
+        msession.register_nds_tables(warehouse_path, fmt="lakehouse")
+        register_refresh_views(msession, refresh_data_path, valid_queries)
+        dm_queries = get_maintenance_queries(
+            msession, MAINTENANCE_SQL_DIR, valid_queries
+        )
+        rows = []
+
+        def run_stream(tag):
+            times, failed = [], 0
+            with obs_trace.bind(qsession.tracer):
+                for qname, qtext in query_dict.items():
+                    rep = BenchReport(qsession)
+                    s = rep.report_on(
+                        run_one_query, qsession, qtext, qname, None,
+                        "parquet", retry_oom=True, name=qname,
+                    )
+                    ms = s["queryTimes"][0]
+                    rows.append((app_id, f"{tag}:{qname}", ms))
+                    if s["queryStatus"][-1] == "Failed":
+                        failed += 1
+                    else:
+                        times.append(float(ms))
+            return times, failed
+
+        dm_stats = {"functions": 0, "failed": 0, "elapsed_s": None,
+                    "vacuums": 0, "vacuum_files_removed": 0,
+                    "error": None}
+
+        def run_dm():
+            # any escape here would otherwise die silently on the daemon
+            # thread and the phase would report a clean run — record it,
+            # finish the report, and let the caller re-raise
+            t0 = time.perf_counter()
+            try:
+                with obs_trace.bind(msession.tracer):
+                    for fname, stmts in dm_queries.items():
+                        rep = BenchReport(msession)
+                        s = rep.report_on(
+                            run_dm_query, msession, stmts, fname, name=fname
+                        )
+                        rows.append(
+                            (app_id, f"dm:{fname}", s["queryTimes"][0])
+                        )
+                        if s["queryStatus"][-1] == "Failed":
+                            dm_stats["failed"] += 1
+                        else:
+                            dm_stats["functions"] += 1
+                    # vacuum WHILE the stream still reads: reader leases
+                    # are the safety contract under test
+                    for res in vacuum_warehouse(
+                        warehouse_path, conf=msession.conf,
+                        retain_last=vacuum_retain,
+                    ):
+                        dm_stats["vacuums"] += 1
+                        dm_stats["vacuum_files_removed"] += (
+                            res["files_removed"]
+                        )
+            except BaseException as exc:
+                dm_stats["error"] = f"{type(exc).__name__}: {exc}"
+            finally:
+                dm_stats["elapsed_s"] = round(time.perf_counter() - t0, 3)
+
+        # warmup pass (recorded but unmeasured): the solo baseline must be
+        # steady-state, or cold XLA compiles land entirely in the solo p99
+        # and the degradation ratio reads as a nonsense speedup
+        print("====== maintenance_under_load: warmup stream ======")
+        run_stream("warmup")
+        print("====== maintenance_under_load: solo baseline stream ======")
+        solo_times, solo_failed = run_stream("solo")
+        print("====== maintenance_under_load: stream + racing DM_* ======")
+        dm_thread = threading.Thread(
+            target=run_dm, name="nds-maintenance-under-load", daemon=True
+        )
+        overlap_start = time.perf_counter()
+        dm_thread.start()
+        load_times, load_failed = run_stream("under_load")
+        dm_thread.join()
+        overlap_s = round(time.perf_counter() - overlap_start, 3)
+
+        solo_p99 = _p99_ms(solo_times)
+        load_p99 = _p99_ms(load_times)
+        report = {
+            "queries": len(query_dict),
+            "solo_failed": solo_failed,
+            "under_load_failed": load_failed,
+            "query_p99_ms_solo": solo_p99,
+            "query_p99_ms_under_load": load_p99,
+            # the headline: how much the racing maintenance hurt the
+            # stream's tail (1.0 = not at all)
+            "query_p99_degradation": (
+                round(load_p99 / solo_p99, 3)
+                if solo_p99 and load_p99 else None
+            ),
+            "dm_functions": dm_stats["functions"],
+            "dm_failed": dm_stats["failed"],
+            "dm_elapsed_s": dm_stats["elapsed_s"],
+            "dm_functions_per_s": (
+                round(dm_stats["functions"] / dm_stats["elapsed_s"], 4)
+                if dm_stats["elapsed_s"] else None
+            ),
+            "vacuums": dm_stats["vacuums"],
+            "vacuum_files_removed": dm_stats["vacuum_files_removed"],
+            "overlap_wall_s": overlap_s,
+        }
+        if dm_stats["error"]:
+            report["dm_error"] = dm_stats["error"]
+        rows.append((app_id, "Maintenance Under Load Time", overlap_s))
+        header = ["application_id", "query", "time/s"]
+        with fs_open_atomic(
+            time_log_output_path, "w", encoding="UTF8", newline=""
+        ) as f:
+            writer = csv.writer(f)
+            writer.writerow(header)
+            writer.writerows(rows)
+        if report_path:
+            import json
+
+            with fs_open_atomic(report_path, "w") as f:
+                json.dump(report, f, indent=2)
+        print(f"====== maintenance_under_load: {report} ======")
+        if dm_stats["error"]:
+            # evidence is on disk; now fail the phase loudly — a broken
+            # maintenance thread must not read as a clean completion
+            raise RuntimeError(
+                f"maintenance-under-load DM thread failed: "
+                f"{dm_stats['error']} (report written to "
+                f"{report_path or time_log_output_path})"
+            )
+        return report
+    finally:
+        # both sessions own their tracers (PR-8 contract: close in
+        # finally so child event segments fold cleanly on any exit)
+        for s in (qsession, msession):
+            if s.tracer is not None:
+                s.tracer.close()
 
 
 def rollback(warehouse_path, timestamp, tables=None):

@@ -64,6 +64,10 @@ METRIC_KINDS = {
     "nds_spill_bytes_in_total": "spill",
     "nds_spill_bytes_out_total": "spill",
     "nds_spill_evictions_total": "spill",
+    "nds_lake_commit_total": "lake_commit",
+    "nds_lake_commit_attempts_total": "lake_commit",
+    "nds_lake_vacuum_total": "lake_vacuum",
+    "nds_lake_vacuum_files_total": "lake_vacuum",
     "nds_fault_injected_total": "fault_injected",
     "nds_ladder_rung_total": "ladder_rung",
     "nds_watchdog_fire_total": "watchdog_fire",
@@ -413,6 +417,24 @@ class MetricsSink:
             "nds_blocked_union_windows_total", int(ev.get("windows") or 0)
         )
 
+    def _h_lake_commit(self, ev):
+        status = "conflict" if ev.get("conflict") else (
+            "rebased" if ev.get("rebased") else "ok"
+        )
+        self.registry.inc(
+            "nds_lake_commit_total",
+            operation=str(ev.get("operation")), status=status,
+        )
+        self.registry.inc(
+            "nds_lake_commit_attempts_total", int(ev.get("attempts") or 1)
+        )
+
+    def _h_lake_vacuum(self, ev):
+        self.registry.inc("nds_lake_vacuum_total", table=str(ev.get("table")))
+        self.registry.inc(
+            "nds_lake_vacuum_files_total", int(ev.get("files_removed") or 0)
+        )
+
     def _h_fault_injected(self, ev):
         self.registry.inc(
             "nds_fault_injected_total", kind=str(ev.get("fault_kind"))
@@ -548,6 +570,8 @@ _HANDLERS = {
     "kernel_span": MetricsSink._h_kernel_span,
     "blocked_union": MetricsSink._h_blocked_union,
     "spill": MetricsSink._h_spill,
+    "lake_commit": MetricsSink._h_lake_commit,
+    "lake_vacuum": MetricsSink._h_lake_vacuum,
     "fault_injected": MetricsSink._h_fault_injected,
     "ladder_rung": MetricsSink._h_ladder_rung,
     "watchdog_fire": MetricsSink._h_watchdog_fire,

@@ -278,6 +278,11 @@ def profile_events(events) -> dict:
         "spill_bytes_in": 0,
         "spill_bytes_out": 0,
         "spill_evictions": 0,
+        "lake_commits": 0,
+        "lake_commit_rebases": 0,
+        "lake_commit_conflicts": 0,
+        "lake_vacuums": 0,
+        "lake_vacuum_files": 0,
         "exec_cache_hits": 0,
         "exec_cache_misses": 0,
         "pipelines_fused": 0,
@@ -330,6 +335,16 @@ def profile_events(events) -> dict:
             tallies["spill_bytes_in"] += int(ev.get("bytes_in") or 0)
             tallies["spill_bytes_out"] += int(ev.get("bytes_out") or 0)
             tallies["spill_evictions"] += int(ev.get("evictions") or 0)
+        elif k == "lake_commit":
+            if ev.get("conflict"):
+                tallies["lake_commit_conflicts"] += 1
+            else:
+                tallies["lake_commits"] += 1
+                if ev.get("rebased"):
+                    tallies["lake_commit_rebases"] += 1
+        elif k == "lake_vacuum":
+            tallies["lake_vacuums"] += 1
+            tallies["lake_vacuum_files"] += int(ev.get("files_removed") or 0)
         elif k == "exec_cache":
             tallies[
                 "exec_cache_hits" if ev.get("hit") else "exec_cache_misses"

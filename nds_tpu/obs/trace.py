@@ -100,6 +100,15 @@ EVENT_SCHEMA = {
     # distinct — bytes into/out of the pool, partition count, and how many
     # segments tiered down to the spill dir
     "spill": ("op", "partitions", "bytes_in", "bytes_out", "evictions"),
+    # one lakehouse manifest publish attempt outcome (lakehouse/table.py
+    # _commit): `attempts` counts OCC tries incl. rebases; successful
+    # commits also carry `rebased`, losers carry `conflict`: true
+    "lake_commit": ("table", "operation", "version", "attempts"),
+    # one lakehouse vacuum (snapshot expiry + unreferenced-file delete):
+    # files_leased counts files KEPT because a live reader lease covers
+    # them — the vacuum safety contract made visible
+    "lake_vacuum": ("table", "files_removed", "manifests_removed",
+                    "files_leased"),
     # liveness beacon from the per-query memory-sampler thread
     # (obs/memwatch.py, armed by report.py while a traced query runs):
     # a hung query keeps heartbeating, so the hang is visible live on

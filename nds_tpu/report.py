@@ -12,7 +12,10 @@ completed despite such incidents is reported `CompletedWithTaskFailures`.
 Failure domain: a failed attempt is classified (faults.classify) and walked
 down a degradation ladder instead of the reference's single implicit task
 retry — device OOM gets recover+retry then a shrunken blocked-union window,
-transient IO gets backoff retries, a hung query is cut off by the watchdog
+transient IO gets backoff retries, a lakehouse commit conflict
+(`commit_conflict`) gets bounded `commit_rebase_retry` re-runs with
+jittered backoff ahead of hard failure (the aborted commit published
+nothing, so the re-run is safe), a hung query is cut off by the watchdog
 (`engine.query_timeout` / NDS_QUERY_TIMEOUT) and recorded as a `timeout`
 failure instead of stalling the stream. Every attempt's error lands in
 `exceptions`, the rungs walked land in `ladder`, and a terminal failure
@@ -70,6 +73,17 @@ _DEGRADED_WINDOW_ROWS = 1 << 18
 #: watchdog poll slice: the deadline loop re-checks spill progress at this
 #: granularity, so a timeout still fires within one slice of its budget
 _WATCHDOG_POLL_S = 0.25
+
+#: commit_rebase_retry budget + backoff: how many times the ladder
+#: re-runs a transaction whose lakehouse commit aborted on an
+#: overwrite/overwrite conflict (the aborted commit never published, so a
+#: re-run derives its writes from the fresh head), and the jittered
+#: backoff base between re-runs (two writers re-running in lockstep would
+#: re-collide forever). Append/append conflicts normally converge inside
+#: table._commit's own rebase loop and never reach this rung. Knobs
+#: (NDS_LAKE_CONFLICT_RETRIES / NDS_LAKE_COMMIT_BACKOFF) are parsed in
+#: ONE place — lakehouse/table.py — shared with maintenance's
+#: statement-level retry.
 
 
 def engine_conf(session) -> dict:
@@ -321,6 +335,17 @@ class BenchReport:
             if sum(1 for r in taken if r == "io_backoff_retry") < retries:
                 return "io_backoff_retry"
             return None
+        if kind == faults.COMMIT_CONFLICT:
+            # an aborted optimistic commit published NOTHING, so re-running
+            # the transaction against the fresh head is safe whenever the
+            # caller vouched for idempotence (can_retry). Sits ahead of
+            # hard failure: bounded re-runs with jittered backoff.
+            from .lakehouse.table import resolve_conflict_retries
+
+            taken_n = sum(1 for r in taken if r == "commit_rebase_retry")
+            if taken_n < resolve_conflict_retries():
+                return "commit_rebase_retry"
+            return None
         return None
 
     def _spill_applicable(self) -> bool:
@@ -339,7 +364,8 @@ class BenchReport:
         rec = getattr(self.session, "last_plan_budget", None)
         return bool(isinstance(rec, dict) and rec.get("spillable"))
 
-    def _apply_rung(self, rung: str, kind: str, io_attempt: int):
+    def _apply_rung(self, rung: str, kind: str, prior_same_rung: int):
+        io_attempt = prior_same_rung  # backoff exponent for retry rungs
         session = self.session
         if rung in ("recover_retry", "shrink_union_window", "budget_shrink",
                     "spill_retry"):
@@ -397,6 +423,24 @@ class BenchReport:
         if rung == "io_backoff_retry":
             _, base = io_retry_budget()
             delay = next(faults.backoff_delays(1, base * (2 ** io_attempt)), 0.0)
+            if delay:
+                time.sleep(delay)
+            return {"delay_s": round(delay, 3)}
+        if rung == "commit_rebase_retry":
+            # jittered backoff before re-running the aborted transaction:
+            # the in-table loop already rebases append/append, so a
+            # conflict reaching the ladder means overwrite writes derived
+            # from a stale snapshot — the re-run re-derives them from the
+            # fresh head (lakehouse/dml.py re-resolves its snapshot)
+            from .lakehouse.table import commit_backoff_base
+
+            prior = io_attempt  # caller passes prior same-rung count
+            delay = next(
+                faults.backoff_delays(
+                    1, commit_backoff_base() * (2 ** prior)
+                ),
+                0.0,
+            )
             if delay:
                 time.sleep(delay)
             return {"delay_s": round(delay, 3)}
@@ -542,10 +586,12 @@ class BenchReport:
                 rung = self._next_rung(kind, rungs, can_retry=retry_oom)
                 if rung is None:
                     break
-                io_retries_so_far = sum(
-                    1 for r in rungs if r["rung"] == "io_backoff_retry"
+                # backoff rungs escalate on their OWN prior count (io and
+                # commit-conflict retries each walk their own exponent)
+                same_rung_so_far = sum(
+                    1 for r in rungs if r["rung"] == rung
                 )
-                detail = self._apply_rung(rung, kind, io_retries_so_far)
+                detail = self._apply_rung(rung, kind, same_rung_so_far)
                 entry = {"rung": rung, "kind": kind}
                 if detail:
                     entry.update(detail)

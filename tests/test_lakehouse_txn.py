@@ -1,0 +1,737 @@
+"""Transactional-lakehouse concurrency tests: snapshot-isolated reads,
+OCC commit-retry with rebase, vacuum under reader leases, crash hygiene,
+and the deterministic two-thread interleaving harness (reference
+semantics: Iceberg/Delta under Spark — snapshot isolation, commit-conflict
+retry, snapshot expiry; nds/nds_maintenance.py:118-202,
+nds_rollback.py:46-51)."""
+
+import json
+import os
+import posixpath
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from nds_tpu import faults
+from nds_tpu.engine.session import Session
+from nds_tpu.lakehouse import table as TBL
+from nds_tpu.lakehouse.leases import LEASES, ReaderLeases
+from nds_tpu.lakehouse.table import (
+    CommitConflictError,
+    LakehouseError,
+    LakehouseTable,
+)
+from nds_tpu.obs.trace import EVENT_SCHEMA, Tracer
+from nds_tpu.report import BenchReport
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults_and_hook():
+    faults.reset()
+    TBL._COMMIT_HOOK = None
+    os.environ["NDS_LAKE_COMMIT_BACKOFF"] = "0"
+    yield
+    faults.reset()
+    TBL._COMMIT_HOOK = None
+    os.environ.pop("NDS_LAKE_COMMIT_BACKOFF", None)
+    os.environ.pop("NDS_LAKE_COMMIT_RETRIES", None)
+    os.environ.pop("NDS_LAKE_CONFLICT_RETRIES", None)
+
+
+def _ints(*vals):
+    return pa.table({"a": pa.array(list(vals), type=pa.int64())})
+
+
+def _make(tmp_path, *vals):
+    path = str(tmp_path / "t")
+    return LakehouseTable.create(path, _ints(*vals)), path
+
+
+def _data_files(path):
+    return sorted(os.listdir(os.path.join(path, "data")))
+
+
+def _manifests(path):
+    return sorted(
+        f for f in os.listdir(os.path.join(path, "_manifests"))
+        if f.startswith("v")
+    )
+
+
+# ---------------------------------------------------------------------------
+# snapshot-isolated reads
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_handle_pins_version(tmp_path):
+    lt, path = _make(tmp_path, 1, 2, 3)
+    snap = lt.snapshot()
+    lt.replace(_ints(9))
+    # the handle still reads the pinned manifest, the table reads the head
+    assert snap.dataset().count_rows() == 3
+    assert snap.num_rows() == 3
+    assert lt.dataset().count_rows() == 1
+    # explicit version resolution
+    assert lt.snapshot(1).dataset().count_rows() == 3
+
+
+def test_session_pin_survives_racing_replace(tmp_path):
+    """The acceptance oracle: a statement pinned at version N returns
+    bit-identical results whether a racing commit lands before, during
+    (between plan and execution, cache wiped), or after it."""
+    lt, path = _make(tmp_path, *range(15))
+    s = Session(conf={"lakehouse.warehouse": str(tmp_path)})
+    s.register_lakehouse("t", path)
+    baseline = s.sql("select a from t order by a").collect()
+
+    # plan (pins the snapshot) ... then the replace lands ... then execute
+    r = s.sql("select a from t order by a")
+    LakehouseTable(path).replace(_ints(99))
+    # wipe every cached device column: execution must re-read through the
+    # PIN, not survive on cache luck
+    s.recover_memory("test: force reload through the pin")
+    assert r.collect().equals(baseline)
+
+    # scanning twice inside one racing window: same pin, same answer
+    r2 = s.sql("select a from t order by a")
+    assert r2.collect().equals(r2.collect())
+
+    # a FRESH statement re-pins and sees the new head
+    assert s.sql("select count(*) c from t").to_pylist() == [{"c": 1}]
+
+
+def test_pin_registers_and_releases_reader_lease(tmp_path):
+    lt, path = _make(tmp_path, 1, 2)
+    root = LakehouseTable(path).root
+    s = Session(conf={"lakehouse.warehouse": str(tmp_path)})
+    s.register_lakehouse("t", path)
+    before = LEASES.live_count(root)
+    s.sql("select count(*) c from t").collect()
+    assert LEASES.live_count(root) == before + 1
+    e = s.catalog.entries["t"]
+    assert e.pinned_version == 1 and e.lease_id is not None
+    # DML invalidation releases the pin's lease
+    s.catalog.invalidate("t")
+    assert LEASES.live_count(root) == before
+    assert e.pinned_version is None and e.lease_id is None
+
+
+def test_dml_delete_reads_one_snapshot_and_aborts_on_conflict(tmp_path):
+    """A DELETE's row count and survivor scan resolve ONE snapshot, and a
+    commit racing the transaction aborts it (overwrite/* never rebases)
+    instead of silently dropping the winner's rows."""
+    lt, path = _make(tmp_path, *range(10))
+    s = Session(conf={"lakehouse.warehouse": str(tmp_path)})
+    s.register_lakehouse("t", path)
+
+    def land_append(name, op, version):
+        TBL._COMMIT_HOOK = None  # fire once
+        LakehouseTable(path).append(_ints(1000))
+
+    TBL._COMMIT_HOOK = land_append
+    with pytest.raises(CommitConflictError):
+        s.sql("delete from t where a >= 5")
+    assert faults.classify(CommitConflictError("x")) == faults.COMMIT_CONFLICT
+    # nothing published by the loser: the winner's append is the head
+    vals = sorted(
+        x["a"] for x in LakehouseTable(path).dataset().to_table().to_pylist()
+    )
+    assert vals == sorted(list(range(10)) + [1000])
+
+
+# ---------------------------------------------------------------------------
+# OCC conflict matrix
+# ---------------------------------------------------------------------------
+
+
+def test_append_append_rebase_converges_both_rows(tmp_path):
+    """Two appends race onto the same version: the loser rebases onto the
+    winner's head and BOTH row sets land (Iceberg fast-append retry)."""
+    lt, path = _make(tmp_path, 0)
+    tracer = Tracer()
+    fired = []
+
+    def land_competitor(name, op, version):
+        if not fired:
+            fired.append(version)
+            TBL._COMMIT_HOOK = None
+            LakehouseTable(path).append(_ints(100))
+
+    from nds_tpu.obs import trace as obs_trace
+
+    TBL._COMMIT_HOOK = land_competitor
+    with obs_trace.bind(tracer):
+        LakehouseTable(path).append(_ints(200))
+    vals = sorted(
+        x["a"] for x in LakehouseTable(path).dataset().to_table().to_pylist()
+    )
+    assert vals == [0, 100, 200]
+    # the loser's lake_commit records the rebase
+    mine = [
+        e for e in tracer.events
+        if e["kind"] == "lake_commit" and e.get("rebased")
+    ]
+    assert mine and mine[0]["attempts"] == 2
+    assert [v for v, _, _ in LakehouseTable(path).versions()] == [1, 2, 3]
+
+
+def test_overwrite_conflict_aborts_and_discards_staged(tmp_path):
+    lt, path = _make(tmp_path, 1, 2, 3)
+
+    def land_append(name, op, version):
+        TBL._COMMIT_HOOK = None
+        LakehouseTable(path).append(_ints(50))
+
+    before_files = set(_data_files(path))
+    TBL._COMMIT_HOOK = land_append
+    with pytest.raises(CommitConflictError):
+        LakehouseTable(path).replace(_ints(7))
+    # the loser's staged file was discarded; only the winner's file is new
+    after = set(_data_files(path))
+    assert len(after - before_files) == 1
+    # the winner's commit is intact (never lost to the aborted overwrite)
+    vals = sorted(
+        x["a"] for x in LakehouseTable(path).dataset().to_table().to_pylist()
+    )
+    assert vals == [1, 2, 3, 50]
+
+
+def test_two_inprocess_writers_same_version_oracle(tmp_path):
+    """The pre-rebase commit-conflict oracle (previously untested): two
+    writers claiming the same version -> exactly one wins, the loser
+    raises a LakehouseError. With retries disabled even an append must
+    surface the conflict — the rebase loop preserves this contract for
+    overwrite/overwrite unconditionally."""
+    lt, path = _make(tmp_path, 0)
+    os.environ["NDS_LAKE_COMMIT_RETRIES"] = "0"
+
+    def land_append(name, op, version):
+        TBL._COMMIT_HOOK = None
+        LakehouseTable(path).append(_ints(1))
+
+    TBL._COMMIT_HOOK = land_append
+    with pytest.raises(LakehouseError) as ei:
+        LakehouseTable(path).append(_ints(2))
+    assert "concurrent commit conflict" in str(ei.value)
+    # exactly one commit won version 2
+    assert [v for v, _, _ in LakehouseTable(path).versions()] == [1, 2]
+
+    # overwrite/overwrite with DEFAULT retries: still an abort, never a
+    # rebase (the matrix the new loop must preserve)
+    os.environ.pop("NDS_LAKE_COMMIT_RETRIES")
+
+    def land_replace(name, op, version):
+        TBL._COMMIT_HOOK = None
+        LakehouseTable(path).replace(_ints(77))
+
+    TBL._COMMIT_HOOK = land_replace
+    with pytest.raises(CommitConflictError):
+        LakehouseTable(path).replace(_ints(88))
+    vals = [
+        x["a"] for x in LakehouseTable(path).dataset().to_table().to_pylist()
+    ]
+    assert vals == [77]  # the winner's replace, untouched
+
+
+def test_deterministic_two_thread_schedule(tmp_path):
+    """Schedule-controlled two-thread harness: the commit hook is a
+    deterministic commit point — thread A parks AT its publish attempt,
+    thread B commits, then A resumes and rebases. No timing luck."""
+    lt, path = _make(tmp_path, 0)
+    a_at_commit = threading.Event()
+    b_done = threading.Event()
+
+    def hook(name, op, version):
+        if threading.current_thread().name == "writer-a":
+            TBL._COMMIT_HOOK = None
+            a_at_commit.set()
+            assert b_done.wait(10)
+
+    TBL._COMMIT_HOOK = hook
+    errs = []
+
+    def writer_a():
+        try:
+            LakehouseTable(path).append(_ints(1))
+        except Exception as e:  # pragma: no cover - failure surfaces below
+            errs.append(e)
+
+    ta = threading.Thread(target=writer_a, name="writer-a")
+    ta.start()
+    assert a_at_commit.wait(10)
+    LakehouseTable(path).append(_ints(2))
+    b_done.set()
+    ta.join(10)
+    assert not errs
+    vals = sorted(
+        x["a"] for x in LakehouseTable(path).dataset().to_table().to_pylist()
+    )
+    assert vals == [0, 1, 2]
+
+
+# ---------------------------------------------------------------------------
+# the commit_rebase_retry ladder rung
+# ---------------------------------------------------------------------------
+
+
+class _Sess:
+    """Minimal session facade for BenchReport."""
+
+    def __init__(self):
+        self.conf = {}
+        self.tracer = None
+        self.metrics = None
+
+    def register_listener(self, cb):
+        pass
+
+    def unregister_listener(self, cb):
+        pass
+
+
+def test_commit_conflict_walks_ladder_then_succeeds():
+    s = _Sess()
+    attempts = []
+
+    def txn():
+        attempts.append(1)
+        if len(attempts) == 1:
+            raise CommitConflictError(
+                "concurrent commit conflict at version 9; retry"
+            )
+
+    rep = BenchReport(s)
+    summary = rep.report_on(txn, retry_oom=True, name="txn")
+    assert summary["queryStatus"] == ["CompletedWithTaskFailures"]
+    assert [r["rung"] for r in summary["ladder"]] == ["commit_rebase_retry"]
+    assert summary["ladder"][0]["kind"] == faults.COMMIT_CONFLICT
+    assert len(attempts) == 2
+
+
+def test_commit_conflict_budget_exhausts_to_hard_failure():
+    os.environ["NDS_LAKE_CONFLICT_RETRIES"] = "2"
+    s = _Sess()
+
+    def txn():
+        raise CommitConflictError("concurrent commit conflict at version 3")
+
+    rep = BenchReport(s)
+    summary = rep.report_on(txn, retry_oom=True, name="txn")
+    assert summary["queryStatus"] == ["Failed"]
+    assert summary["failureKind"] == faults.COMMIT_CONFLICT
+    assert [r["rung"] for r in summary["ladder"]] == [
+        "commit_rebase_retry", "commit_rebase_retry",
+    ]
+
+
+def test_commit_conflict_without_retry_opt_in_fails_fast():
+    s = _Sess()
+
+    def txn():
+        raise CommitConflictError("concurrent commit conflict at version 3")
+
+    summary = BenchReport(s).report_on(txn, name="txn")  # no retry_oom
+    assert summary["queryStatus"] == ["Failed"]
+    assert "ladder" not in summary
+
+
+# ---------------------------------------------------------------------------
+# crash hygiene + fault sites
+# ---------------------------------------------------------------------------
+
+
+def test_crash_at_commit_during_replace_is_all_or_nothing(tmp_path):
+    """Satellite regression: a crash fault at commit:<table> during
+    replace() (explicit base_files) leaves the PREVIOUS snapshot fully
+    readable — staged files orphaned, no manifest published — pinning the
+    all-or-nothing guarantee the commit-site comment promises."""
+    lt, path = _make(tmp_path, 1, 2, 3)
+    manifests_before = _manifests(path)
+    files_before = _data_files(path)
+    faults.install("crash:commit:t")
+    with pytest.raises(faults.InjectedCrash):
+        LakehouseTable(path).replace(_ints(9))
+    faults.reset()
+    # no manifest published; previous snapshot intact and readable
+    assert _manifests(path) == manifests_before
+    lt2 = LakehouseTable(path)
+    assert lt2.current_version() == 1
+    assert sorted(
+        x["a"] for x in lt2.dataset().to_table().to_pylist()
+    ) == [1, 2, 3]
+    # the staged file IS orphaned on disk (crash landed pre-publish)
+    orphans = set(_data_files(path)) - set(files_before)
+    assert len(orphans) == 1
+
+
+def test_crash_at_stage_never_loses_committed_snapshot(tmp_path):
+    lt, path = _make(tmp_path, 1, 2, 3)
+    manifests_before = _manifests(path)
+    faults.install("crash:stage:t")
+    with pytest.raises(faults.InjectedCrash):
+        LakehouseTable(path).append(_ints(4))
+    faults.reset()
+    assert _manifests(path) == manifests_before
+    assert sorted(
+        x["a"] for x in LakehouseTable(path).dataset().to_table().to_pylist()
+    ) == [1, 2, 3]
+
+
+def test_stage_write_io_fault_walks_io_ladder(tmp_path):
+    """An io fault at the stage:<table> site classifies io_transient and
+    the ladder's backoff rung retries the transaction to completion."""
+    lt, path = _make(tmp_path, 1)
+    faults.install("io:stage:t:1")
+    s = _Sess()
+
+    def txn():
+        LakehouseTable(path).append(_ints(2))
+
+    summary = BenchReport(s).report_on(txn, retry_oom=True, name="txn")
+    assert summary["queryStatus"] == ["CompletedWithTaskFailures"]
+    assert [r["rung"] for r in summary["ladder"]] == ["io_backoff_retry"]
+    assert LakehouseTable(path).num_rows() == 2
+
+
+def test_manifest_read_io_fault_site(tmp_path):
+    lt, path = _make(tmp_path, 1)
+    faults.install("io:manifest:t:1")
+    with pytest.raises(faults.TransientIOError):
+        LakehouseTable(path).snapshot()
+    faults.reset()
+    assert LakehouseTable(path).snapshot().version == 1
+
+
+def test_vacuum_crash_never_loses_committed_snapshot(tmp_path):
+    lt, path = _make(tmp_path, 1, 2)
+    lt.replace(_ints(3))
+    lt.replace(_ints(4))
+    faults.install("crash:vacuum:t")
+    with pytest.raises(faults.InjectedCrash):
+        LakehouseTable(path).vacuum(retain_last=1)
+    faults.reset()
+    # every retained manifest still resolves and its files exist
+    lt2 = LakehouseTable(path)
+    for v, _, _ in lt2.versions():
+        for f in lt2.snapshot(v).files():
+            assert os.path.exists(f)
+    # a re-run completes the job
+    res = lt2.vacuum(retain_last=1)
+    assert res["manifests_removed"] == 2 and res["files_removed"] == 2
+    assert lt2.dataset().to_table().to_pylist() == [{"a": 4}]
+
+
+def test_orphan_sweep_units(tmp_path):
+    lt, path = _make(tmp_path, 1, 2)
+    data = os.path.join(path, "data")
+    mans = os.path.join(path, "_manifests")
+    dead_stage = "part-999999-abcdefabcdef.parquet"
+    live_stage = f"part-{os.getpid()}-abcdefabcdef.parquet"
+    torn_tmp = ".tmp-999999-deadbeef.json"
+    live_tmp = f".tmp-{os.getpid()}-deadbeef.json"
+    foreign = "somebody-elses.file"
+    legacy = "part-abcdefabcdef.parquet"  # pre-pid format: unattributable
+    for n in (dead_stage, live_stage, foreign, legacy):
+        open(os.path.join(data, n), "w").close()
+    for n in (torn_tmp, live_tmp):
+        open(os.path.join(mans, n), "w").close()
+    assert lt.sweep_orphans() == 2
+    remaining = set(os.listdir(data))
+    assert dead_stage not in remaining
+    assert {live_stage, foreign, legacy} <= remaining
+    man_remaining = set(os.listdir(mans))
+    assert torn_tmp not in man_remaining and live_tmp in man_remaining
+    # committed (referenced) files are never sweep candidates
+    assert lt.dataset().count_rows() == 2
+
+
+def test_session_start_sweep_removes_crashed_writer_orphans(tmp_path):
+    lt, path = _make(tmp_path, 1)
+    orphan = "part-999999-abcdefabcdef.parquet"
+    open(os.path.join(path, "data", orphan), "w").close()
+    s = Session(conf={})
+    s.register_lakehouse("t", path)
+    assert orphan not in _data_files(path)
+    # file-set equality against the retained manifests
+    referenced = set()
+    lt2 = LakehouseTable(path)
+    for v, _, _ in lt2.versions():
+        referenced.update(
+            posixpath.basename(f) for f in lt2.snapshot(v).files()
+        )
+    assert set(_data_files(path)) == referenced
+
+
+# ---------------------------------------------------------------------------
+# vacuum + leases
+# ---------------------------------------------------------------------------
+
+
+def test_vacuum_respects_retention_and_reader_leases(tmp_path):
+    lt, path = _make(tmp_path, *range(10))
+    lt.replace(_ints(1, 2))   # v2
+    lt.replace(_ints(3))      # v3
+    lt.replace(_ints(4))      # v4
+    root = LakehouseTable(path).root
+    snap1 = lt.snapshot(1)
+    lease = LEASES.acquire(root, 1, snap1.rel_files, ttl_s=60)
+    res = lt.vacuum(retain_last=2)
+    # v2 expired + collected; v1 survives whole (leased version keeps its
+    # manifest), v3/v4 retained
+    assert res["manifests_removed"] == 1 and res["files_removed"] == 1
+    assert [v for v, _, _ in lt.versions()] == [1, 3, 4]
+    for v, _, _ in lt.versions():
+        for f in lt.snapshot(v).files():
+            assert os.path.exists(f)
+    # lease-file protection proper: even with the manifest gone, a leased
+    # file is never deleted
+    os.unlink(os.path.join(path, "_manifests", "v000001.json"))
+    res2 = lt.vacuum(retain_last=2)
+    assert res2["files_leased"] == 1
+    assert posixpath.basename(snap1.rel_files[0]) in set(_data_files(path))
+    LEASES.release(lease)
+
+
+def test_expired_lease_no_longer_blocks_vacuum(tmp_path):
+    lt, path = _make(tmp_path, 1)
+    lt.replace(_ints(2))
+    root = LakehouseTable(path).root
+    snap1 = lt.snapshot(1)
+    LEASES.acquire(root, 1, snap1.rel_files, ttl_s=0.05)
+    time.sleep(0.1)
+    res = lt.vacuum(retain_last=1)
+    assert res["manifests_removed"] == 1 and res["files_removed"] == 1
+    assert posixpath.basename(snap1.rel_files[0]) not in set(
+        _data_files(path)
+    )
+
+
+def test_vacuum_never_deletes_file_under_live_session_pin(tmp_path):
+    """End to end: a session's plan-time pin (not a hand-made lease) is
+    what protects the files its query still reads."""
+    lt, path = _make(tmp_path, *range(5))
+    s = Session(conf={"lakehouse.warehouse": str(tmp_path)})
+    s.register_lakehouse("t", path)
+    r = s.sql("select a from t order by a")  # pins v1 + leases its files
+    baseline = r.collect()
+    LakehouseTable(path).replace(_ints(9))           # v2: head moves on
+    res = LakehouseTable(path).vacuum(retain_last=1)  # tries to drop v1
+    # v1's manifest is leased -> retained; its files still exist
+    s.recover_memory("test: force re-read through the pin")
+    assert r.collect().equals(baseline)
+    assert res["files_removed"] == 0
+
+
+def test_expire_snapshots_keeps_head_always(tmp_path):
+    lt, path = _make(tmp_path, 1)
+    assert lt.expire_snapshots(retain_last=1) == []
+    assert [v for v, _, _ in lt.versions()] == [1]
+
+
+def test_lease_table_units():
+    lt = ReaderLeases()
+    i1 = lt.acquire("/r", 3, ["data/a", "data/b"], ttl_s=60)
+    i2 = lt.acquire("/r", 4, ["data/c"], ttl_s=60)
+    lt.acquire("/other", 1, ["data/z"], ttl_s=60)
+    assert lt.held_versions("/r") == {3, 4}
+    assert lt.held_files("/r") == {"data/a", "data/b", "data/c"}
+    assert lt.live_count("/r") == 2
+    assert lt.release(i1) and not lt.release(i1)
+    assert lt.held_files("/r") == {"data/c"}
+    assert lt.renew(i2, ttl_s=60)
+    i3 = lt.acquire("/r", 5, ["data/d"], ttl_s=0.01)
+    time.sleep(0.05)
+    assert 5 not in lt.held_versions("/r")
+    assert not lt.renew(i3, ttl_s=60)
+
+
+def test_versions_tolerates_concurrently_expired_manifest(tmp_path):
+    """A manifest vanishing between the listing and its read (a racing
+    expire_snapshots) must read as the post-expiry log, not crash the
+    reader with FileNotFoundError."""
+    lt, path = _make(tmp_path, 1)
+    lt.append(_ints(2))
+
+    class _FlakyFS:
+        def __init__(self, inner, fail_substr):
+            self._inner = inner
+            self._sub = fail_substr
+            self._fired = False
+
+        def open(self, p, *a, **kw):
+            if not self._fired and self._sub in str(p):
+                self._fired = True
+                raise FileNotFoundError(p)
+            return self._inner.open(p, *a, **kw)
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    lt.fs = _FlakyFS(lt.fs, "v000001.json")
+    assert [v for v, _, _ in lt.versions()] == [2]  # v1 skipped, no crash
+    assert lt.current_version() == 2  # filename-derived: no reads at all
+
+
+def test_remote_warehouse_never_pid_attributes(tmp_path):
+    """Pid liveness is host-local: on a shared (remote) warehouse the
+    sweep is a no-op and vacuum protects every never-referenced stage —
+    a live writer on another host must not lose its in-flight commit."""
+    import uuid as _uuid
+
+    root = f"memory://lake-{_uuid.uuid4().hex}/t"
+    lt = LakehouseTable.create(root, _ints(1))
+    lt.replace(_ints(2))
+    # a "dead-pid" staged file: on a local table this would be swept
+    lt.fs.pipe_file(
+        lt.data_dir + "/part-999999-abcdefabcdef.parquet", b"x"
+    )
+    assert not lt._is_local()
+    assert lt.sweep_orphans() == 0
+    res = lt.vacuum(retain_last=1)
+    names = {
+        f.rsplit("/", 1)[-1]
+        for f in lt.fs.ls(lt.data_dir, detail=False)
+    }
+    assert "part-999999-abcdefabcdef.parquet" in names  # protected
+    assert res["files_removed"] == 1  # v1's committed-then-expired file
+
+
+def test_conflict_knob_parsing_single_home():
+    from nds_tpu.lakehouse.table import (
+        commit_backoff_base,
+        resolve_conflict_retries,
+    )
+
+    os.environ["NDS_LAKE_CONFLICT_RETRIES"] = "7"
+    assert resolve_conflict_retries() == 7
+    os.environ["NDS_LAKE_CONFLICT_RETRIES"] = "junk"
+    assert resolve_conflict_retries() == 2
+    del os.environ["NDS_LAKE_CONFLICT_RETRIES"]
+    assert commit_backoff_base() == 0.0  # fixture sets backoff env to 0
+
+
+def test_shared_session_concurrent_repin_serves_plan_version(tmp_path):
+    """The detached-load guard: a plan pinned at vN on a session whose
+    entry another statement re-pinned to vM still reads vN — including
+    through the all-columns-cached path."""
+    lt, path = _make(tmp_path, 1, 2, 3)
+    s = Session(conf={"lakehouse.warehouse": str(tmp_path)})
+    s.register_lakehouse("t", path)
+    r_old = s.sql("select a from t order by a")  # pins v1
+    old = r_old.collect()
+    LakehouseTable(path).replace(_ints(9))
+    # a second statement re-pins the ENTRY to v2 and loads its columns
+    # into the shared device cache
+    assert s.sql("select count(*) c from t").to_pylist() == [{"c": 1}]
+    # the v1 plan re-executes against the re-pinned, fully-cached entry:
+    # the detached load must serve v1, not the cached v2 columns
+    r_old._table = None  # force a fresh execution of the same pinned plan
+    assert r_old.collect().equals(old)
+
+
+# ---------------------------------------------------------------------------
+# rollback semantics (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_rollback_timestamp_tie_selects_that_snapshot(tmp_path):
+    lt, path = _make(tmp_path, 1, 2)
+    v1_ts = lt.versions()[0][1]
+    lt.append(_ints(3))
+    # ts EXACTLY equal to v1's strictly-monotonic stamp selects v1
+    v = lt.rollback_to_timestamp(v1_ts)
+    assert v == 3
+    assert lt.dataset().count_rows() == 2
+    # one ms earlier: nothing at-or-before -> loud error
+    with pytest.raises(LakehouseError):
+        LakehouseTable(path).rollback_to_timestamp(v1_ts - 1)
+
+
+def test_rollback_of_rollback_replays_right_file_list(tmp_path):
+    lt, path = _make(tmp_path, 1, 2)        # v1
+    lt.append(_ints(3))                     # v2
+    v3 = lt.rollback_to_version(1)          # v3 == v1's files
+    lt.append(_ints(4))                     # v4
+    v3_ts = dict(
+        (v, ts) for v, ts, _ in lt.versions()
+    )[v3]
+    v5 = lt.rollback_to_timestamp(v3_ts)    # rollback OF the rollback
+    assert v5 == 5
+    m1 = lt.snapshot(1).rel_files
+    m5 = lt.snapshot(5).rel_files
+    assert m5 == m1  # replays v1's exact file list (via v3)
+    assert sorted(
+        x["a"] for x in lt.dataset().to_table().to_pylist()
+    ) == [1, 2]
+
+
+# ---------------------------------------------------------------------------
+# events + metrics
+# ---------------------------------------------------------------------------
+
+
+def test_lake_events_schema_and_metrics(tmp_path):
+    from nds_tpu.obs import trace as obs_trace
+    from nds_tpu.obs.metrics import MetricsSink
+    from nds_tpu.obs.reader import validate_events
+
+    lt, path = _make(tmp_path, 1)
+    sink = MetricsSink()
+    tracer = Tracer(sink=sink)
+    with obs_trace.bind(tracer):
+        lt.append(_ints(2))
+        lt.replace(_ints(3))
+        lt.vacuum(retain_last=1)
+    kinds = [e["kind"] for e in tracer.events]
+    assert kinds.count("lake_commit") == 2
+    assert kinds.count("lake_vacuum") == 1
+    assert validate_events(tracer.events) == []
+    for e in tracer.events:
+        if e["kind"] == "lake_commit":
+            for field in EVENT_SCHEMA["lake_commit"]:
+                assert field in e
+        if e["kind"] == "lake_vacuum":
+            for field in EVENT_SCHEMA["lake_vacuum"]:
+                assert field in e
+    reg = sink.registry
+    assert reg.counter_value(
+        "nds_lake_commit_total", operation="append", status="ok"
+    ) == 1
+    assert reg.counter_value(
+        "nds_lake_commit_total", operation="overwrite", status="ok"
+    ) == 1
+    assert reg.counter_value("nds_lake_commit_attempts_total") == 2
+    assert reg.counter_value("nds_lake_vacuum_total", table="t") == 1
+
+
+def test_profile_tallies_lake_events(tmp_path):
+    from nds_tpu.obs.reader import profile_events
+
+    lt, path = _make(tmp_path, 1)
+    tracer = Tracer()
+    from nds_tpu.obs import trace as obs_trace
+
+    with obs_trace.bind(tracer):
+        lt.append(_ints(2))
+        try:
+            def clash(name, op, version):
+                TBL._COMMIT_HOOK = None
+                LakehouseTable(path).append(_ints(7))
+
+            TBL._COMMIT_HOOK = clash
+            lt.replace(_ints(3))
+        except CommitConflictError:
+            pass
+        # a successful replace detaches the old files, so vacuum has work
+        LakehouseTable(path).replace(_ints(9))
+        lt.vacuum(retain_last=1)
+    prof = profile_events(tracer.events)
+    t = prof["tallies"]
+    # create is untraced; append + clash-append + final replace succeed
+    assert t["lake_commits"] == 3
+    assert t["lake_commit_conflicts"] == 1
+    assert t["lake_vacuums"] == 1
+    assert t["lake_vacuum_files"] >= 1
