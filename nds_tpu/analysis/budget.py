@@ -340,6 +340,34 @@ def default_window_rows(row_bytes: int, budget_bytes: int) -> int:
     return int(min(max(pow2, 1 << 16), 1 << 24))
 
 
+def derive_share_bytes(total_bytes: int, fraction: int,
+                       lo: int, hi: int) -> int:
+    """A byte budget as 1/`fraction` of a measured resource, rounded DOWN
+    to a power of two and clamped to [lo, hi] — the same shape as the
+    union-window derivation above (default_window_rows), generalized so
+    every `auto` budget in the engine sizes itself the same way: the spill
+    pool's host-RAM share (engine.spill_pool_bytes=auto) and the AOT
+    executable cache's disk share (engine.aot_cache_bytes unset) both
+    delegate here instead of inventing their own formula."""
+    share = max(int(total_bytes) // max(int(fraction), 1), 1)
+    pow2 = 1 << (share.bit_length() - 1)
+    return int(min(max(pow2, lo), hi))
+
+
+def host_ram_bytes() -> int:
+    """Physical host RAM in bytes (sysconf), falling back to a 16 GiB
+    assumption on platforms without the counters — the `auto` budget
+    derivations must never crash over a missing proc interface."""
+    try:
+        pages = os.sysconf("SC_PHYS_PAGES")
+        page = os.sysconf("SC_PAGE_SIZE")
+        if pages > 0 and page > 0:
+            return int(pages) * int(page)
+    except (ValueError, OSError, AttributeError):
+        pass
+    return 16 << 30
+
+
 # ---------------------------------------------------------------------------
 # catalog cardinality source
 # ---------------------------------------------------------------------------

@@ -502,16 +502,24 @@ def _r_undocumented_conf_knob(tree, relpath):
 
 #: session-level caches whose mutation must hold the session cache lock
 #: (Session.cache_lock): the serve work (ROADMAP item 4) makes these
-#: multi-tenant, and every unguarded mutation is a latent race today
+#: multi-tenant, and every unguarded mutation is a latent race today.
+#: `aot_cache` (the persistent executable cache) and `promotion_store`
+#: (the persisted A/B verdicts) are internally locked AND cross-process
+#: atomic (tempfile+rename), but their session-level mutation sites hold
+#: the same discipline so a future refactor cannot silently regress them.
 _GUARDED_CACHES = (
     "exec_cache", "join_order_cache", "pallas_promotions", "plan_cache",
+    "aot_cache", "promotion_store",
 )
 
 #: attribute calls that mutate a cache object (ExecutableCache.lookup
-#: builds + inserts; OrderedDict/dict mutators). Plain `.get` reads are
-#: not flagged — the LRU caches' own get() sites are lock-wrapped anyway.
+#: builds + inserts; AotCache.store/vacuum write + unlink entries;
+#: PromotionStore.record merges a verdict; OrderedDict/dict mutators).
+#: Plain `.get`/`.load` reads are not flagged — the LRU caches' own get()
+#: sites are lock-wrapped anyway.
 _CACHE_MUTATORS = (
     "clear", "put", "pop", "popitem", "update", "setdefault", "lookup",
+    "store", "vacuum", "record",
 )
 
 
@@ -548,16 +556,35 @@ def _r_cache_lock_discipline(tree, relpath):
         return any(a <= line <= b for a, b in lock_spans)
 
     # local-alias taint: `cache = self._session_cache()` / `c = s.plan_cache`
+    # / `c = getattr(s, "plan_cache", None)` — the string-constant getattr
+    # form reaches the same object with no Attribute node, so without it
+    # an alias could silently dodge the rule
+    def _getattr_cache_name(src):
+        if (
+            isinstance(src, ast.Call)
+            and isinstance(src.func, ast.Name)
+            and src.func.id == "getattr"
+            and len(src.args) >= 2
+            and isinstance(src.args[1], ast.Constant)
+            and src.args[1].value in _GUARDED_CACHES
+        ):
+            return src.args[1].value
+        return None
+
     tainted = set()
     for node in ast.walk(tree):
         if isinstance(node, ast.Assign) and isinstance(
             node.value, (ast.Attribute, ast.Call)
         ):
             src = node.value
-            hit = _chain_cache_name(src) is not None or (
-                isinstance(src, ast.Call)
-                and isinstance(src.func, ast.Attribute)
-                and src.func.attr == "_session_cache"
+            hit = (
+                _chain_cache_name(src) is not None
+                or _getattr_cache_name(src) is not None
+                or (
+                    isinstance(src, ast.Call)
+                    and isinstance(src.func, ast.Attribute)
+                    and src.func.attr == "_session_cache"
+                )
             )
             if hit:
                 for t in node.targets:

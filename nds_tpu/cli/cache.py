@@ -1,0 +1,194 @@
+"""Operator CLI for the persistent AOT executable cache
+(engine/aotcache.py): inspect it, warm it ahead of serving, clean it up.
+
+    nds-tpu-submit cache stats  [--cache_dir D] [--json]
+    nds-tpu-submit cache warm   <data_dir> <stream.sql> [--cache_dir D]
+                                [--format parquet|csv|lakehouse]
+                                [--queries q1,q2] [--json]
+    nds-tpu-submit cache vacuum [--cache_dir D] [--all] [--json]
+
+`stats` reports entry count/bytes vs budget, quarantine/temp counts, and
+persisted promotion verdicts. `warm` runs a query stream's templates once
+against a registered warehouse with the cache armed, so every pipeline
+executable (and promotion verdict) is ON DISK before a serving fleet's
+first request — the fleet's cold start then deserializes instead of
+compiling (the production half of "compile each pipeline once, ever";
+the SF10 isolation parent does the same for its children through
+NDS_AOT_CACHE_DIR). `vacuum` sweeps dead-pid temp orphans + quarantined
+entries and re-enforces the byte budget; `--all` drops every committed
+entry too (the operator reset after e.g. an engine upgrade soak).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _resolve_dir(args) -> str:
+    from ..engine.aotcache import resolve_aot_cache_dir
+
+    d = args.cache_dir or resolve_aot_cache_dir()
+    if not d:
+        print("cache: AOT cache disabled (NDS_AOT_CACHE_DIR=0) and no "
+              "--cache_dir given", file=sys.stderr)
+        sys.exit(2)
+    return d
+
+
+def _dir_stats(d: str) -> dict:
+    from ..engine.aotcache import (
+        AotCache,
+        PromotionStore,
+        resolve_aot_cache_bytes,
+    )
+
+    cache = AotCache(d, resolve_aot_cache_bytes(None, d))
+    entries, total = cache.usage()
+    names = os.listdir(d) if os.path.isdir(d) else []
+    return {
+        "cache_dir": d,
+        "entries": entries,
+        "bytes": total,
+        "budget_bytes": cache.budget,
+        "quarantined": sum(1 for n in names if n.startswith("quarantine-")),
+        "temps": sum(1 for n in names if ".tmp-" in n),
+        "promotions": PromotionStore(d).count(),
+    }
+
+
+def stats_main(args) -> int:
+    st = _dir_stats(_resolve_dir(args))
+    if args.as_json:
+        print(json.dumps(st, indent=2))
+        return 0
+    print(f"== aot cache {st['cache_dir']}")
+    print(f"   entries      {st['entries']} "
+          f"({st['bytes']:,} B of {st['budget_bytes']:,} B budget)")
+    print(f"   quarantined  {st['quarantined']}")
+    print(f"   temps        {st['temps']}")
+    print(f"   promotions   {st['promotions']} persisted verdict(s)")
+    return 0
+
+
+def warm_main(args) -> int:
+    os.environ["NDS_AOT_CACHE_DIR"] = _resolve_dir(args)
+    from ..engine.session import Session
+    from ..power import gen_sql_from_stream
+
+    sess = Session(conf={"engine.aot_cache_dir": os.environ["NDS_AOT_CACHE_DIR"]})
+    sess.register_nds_tables(args.data_dir, fmt=args.format)
+    if not sess.catalog.entries:
+        print(f"cache warm: no tables found under {args.data_dir}",
+              file=sys.stderr)
+        return 2
+    queries = gen_sql_from_stream(args.stream)
+    if args.queries:
+        keep = {s.strip() for s in args.queries.split(",") if s.strip()}
+        queries = {n: q for n, q in queries.items() if n in keep}
+    ok, failed = 0, {}
+    t0 = time.perf_counter()
+    for name, q in queries.items():
+        try:
+            r = sess.run_script(q)
+            if r is not None:
+                r.collect()
+            ok += 1
+        except Exception as exc:  # warm what warms; report the rest
+            failed[name] = str(exc)[:200]
+    aot = sess.aot_cache
+    report = {
+        "queries_warmed": ok,
+        "queries_failed": len(failed),
+        "wall_sec": round(time.perf_counter() - t0, 2),
+        "aot": dict(aot.stats) if aot is not None else None,
+        "stats": _dir_stats(_resolve_dir(args)),
+    }
+    if failed:
+        report["failed"] = failed
+    if args.as_json:
+        print(json.dumps(report, indent=2))
+    else:
+        a = report["aot"] or {}
+        print(f"cache warm: {ok} template(s) warmed in "
+              f"{report['wall_sec']}s ({len(failed)} failed); "
+              f"{a.get('stores', 0)} executable(s) newly stored, "
+              f"{a.get('disk_hits', 0)} already on disk; "
+              f"{report['stats']['entries']} entr(ies) / "
+              f"{report['stats']['bytes']:,} B total")
+        for n, e in failed.items():
+            print(f"   failed {n}: {e}", file=sys.stderr)
+    if queries and ok == 0:
+        # "warm what warms" tolerates stragglers, but a warm run where
+        # NOTHING warmed means the fleet will cold-start exactly as if
+        # this step never ran — a deploy pipeline must see that
+        print("cache warm: every template failed; cache is still cold",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+def vacuum_main(args) -> int:
+    from ..engine.aotcache import AotCache, resolve_aot_cache_bytes
+
+    d = _resolve_dir(args)
+    cache = AotCache(d, resolve_aot_cache_bytes(None, d))
+    removed = cache.vacuum(drop_all=args.drop_all)
+    st = _dir_stats(d)
+    if args.as_json:
+        print(json.dumps({"removed": removed, "stats": st}, indent=2))
+    else:
+        print(f"cache vacuum: removed {removed} file(s); "
+              f"{st['entries']} entr(ies) / {st['bytes']:,} B remain")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="nds-tpu-submit cache",
+        description="inspect / warm / vacuum the persistent AOT "
+        "executable cache",
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    def _common(p):
+        p.add_argument("--cache_dir", default=None,
+                       help="cache directory (default: the engine's "
+                       "resolved NDS_AOT_CACHE_DIR)")
+        p.add_argument("--json", action="store_true", dest="as_json",
+                       help="emit the report as JSON")
+
+    p_stats = sub.add_parser("stats", help="entry/bytes/promotions report")
+    _common(p_stats)
+    p_warm = sub.add_parser(
+        "warm",
+        help="run a stream's templates once so every executable is on "
+        "disk before serving",
+    )
+    p_warm.add_argument("data_dir", help="warehouse directory to register")
+    p_warm.add_argument("stream", help="query stream file (query_N.sql)")
+    p_warm.add_argument("--format", default="parquet",
+                        choices=["parquet", "csv", "lakehouse", "orc"],
+                        help="warehouse format (parquet)")
+    p_warm.add_argument("--queries", default=None,
+                        help="comma-separated template subset")
+    _common(p_warm)
+    p_vac = sub.add_parser(
+        "vacuum",
+        help="sweep temp orphans + quarantines, re-enforce the budget",
+    )
+    p_vac.add_argument("--all", action="store_true", dest="drop_all",
+                       help="also drop every committed entry (full reset)")
+    _common(p_vac)
+
+    args = parser.parse_args(argv)
+    if args.cmd == "stats":
+        return stats_main(args)
+    if args.cmd == "warm":
+        return warm_main(args)
+    return vacuum_main(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

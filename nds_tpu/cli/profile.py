@@ -145,6 +145,18 @@ def _render_profile(prof, top: int, per_query: bool):
               f"{t['pipelines_eager']} eager; executable cache "
               f"{t['exec_cache_hits']} hit / {t['exec_cache_misses']} miss "
               f"(rate {rate_s})")
+    # persistent AOT executable cache evidence (aot_cache events); .get()
+    # because compacted artifacts from pre-AOT runs lack the keys
+    aot_rate = R.aot_disk_hit_rate(prof)
+    if aot_rate is not None or t.get("aot_stores") or t.get(
+        "aot_quarantined"
+    ):
+        rate_s = "-" if aot_rate is None else f"{aot_rate:.1%}"
+        print(f"== aot cache: {t.get('aot_disk_hits', 0)} disk hit / "
+              f"{t.get('aot_misses', 0)} miss (rate {rate_s}); "
+              f"{t.get('aot_stores', 0)} stored, "
+              f"{t.get('aot_evictions', 0)} evicted, "
+              f"{t.get('aot_quarantined', 0)} quarantined")
     kernels = sorted(
         prof.get("kernel_totals", {}).items(),
         key=lambda kv: -kv[1]["dur_ms"],

@@ -26,6 +26,7 @@ from time import perf_counter as _perf
 from .. import faults
 from ..dtypes import BOOL, DType, FLOAT64, INT64
 from ..ops import kernels as K
+from . import aotcache as AOTC
 from . import expr as E
 from . import fuse
 from . import plan as P
@@ -382,6 +383,19 @@ class Executor:
     # eager per-stage path, and the signature is pinned so the build is
     # attempted once.
 
+    def _aot_build_args(self, session):
+        """(AotCache | None, conf signature) for a FusedPipeline build:
+        the session's persistent executable cache plus the engine conf
+        values that change traced code and therefore join the on-disk
+        entry key (engine/aotcache.py key discipline)."""
+        aot = getattr(session, "aot_cache", None) if session else None
+        if aot is None:
+            return None, ()
+        return aot, (
+            str(session.conf.get("engine.fuse_agg", "on")),
+            str(session.conf.get("engine.pallas_agg", "off")),
+        )
+
     def _exec_pipeline(self, node: P.Pipeline) -> Table:
         child = self.execute(node.child)
         session = getattr(self.catalog, "session", None)
@@ -410,14 +424,19 @@ class Executor:
                     P.Pipeline(stages=node.stages, child=None, agg=node.agg)
                 )
             sig = fuse.input_signature(child, with_stats=has_agg)
+            aot, conf_sig = self._aot_build_args(session)
             if has_agg:
                 def build():
                     return fuse.FusedAggPipeline(
-                        node.stages, node.agg, child
+                        node.stages, node.agg, child,
+                        aot=aot, fp=fp, conf_sig=conf_sig,
                     )
             else:
                 def build():
-                    return fuse.FusedPipeline(node.stages, child)
+                    return fuse.FusedPipeline(
+                        node.stages, child, aot=aot, fp=fp,
+                        conf_sig=conf_sig,
+                    )
             with session.cache_lock:
                 entry, hit = session.exec_cache.lookup(
                     fp, sig, child.cap, build
@@ -528,7 +547,7 @@ class Executor:
         words, dist = self._sort_order_words(node, child)
         if dist is not None:
             return dist
-        order = K.sort_by_words(words)
+        order = self._sort_perm_route(words)
         parts = self._spill_parts_for(node)
         if parts > 1:
             # external sort: the SAME device sort order, but the output
@@ -1744,9 +1763,13 @@ class Executor:
         if fp is None:
             return self._apply_wrappers(t, wrappers)
         sig = fuse.input_signature(t)
+        aot, conf_sig = self._aot_build_args(session)
         with session.cache_lock:
             entry, hit = session.exec_cache.lookup(
-                fp, sig, t.cap, lambda: fuse.FusedPipeline(stages, t)
+                fp, sig, t.cap,
+                lambda: fuse.FusedPipeline(
+                    stages, t, aot=aot, fp=fp, conf_sig=conf_sig
+                ),
             )
         if self.tracer is not None:
             self.tracer.emit(
@@ -2293,6 +2316,57 @@ class Executor:
             return "off"
         return str(session.conf.get("engine.pallas_agg", "off")).lower()
 
+    def _sort_perm_route(self, words):
+        """ORDER BY permutation with optional Pallas counting-sort
+        promotion (`engine.pallas_sort`): `off` (default) — the canonical
+        kv-sort kernel; `on` — route eligible words through the Pallas
+        counting sort (ops/pallas_kernels.sort_perm_pallas, identical
+        stable ascending permutation by construction); `auto` — the same
+        measured per-shape A/B as the aggregate/join routes, memoized on
+        `Session.pallas_promotions` AND the persistent promotion store
+        under key ("sort_perm", rows, domain). Eligible: exactly one sort
+        word whose value span fits the counting domain (the span probe is
+        one fused dispatch + one host sync, paid only in on/auto modes) —
+        everything else stays on the canonical kernel unconditionally."""
+        session = getattr(self.catalog, "session", None)
+        mode = (
+            str(session.conf.get("engine.pallas_sort", "off")).lower()
+            if session is not None
+            else "off"
+        )
+        if mode not in ("on", "auto") or len(words) != 1:
+            return K.sort_by_words(words)
+        # opt-in backend: the Pallas import compiles Mosaic machinery the
+        # default path never needs — it must stay BEHIND the mode gate
+        # nds-lint: disable=local-import
+        from ..ops import pallas_kernels as PK
+
+        if int(words[0].shape[0]) > PK.SORT_MAX_ROWS:
+            return K.sort_by_words(words)
+        w = words[0]
+        lo, hi = (int(x) for x in jax.device_get(K.word_span(w)))
+        if lo < 0 or hi >= PK.SORT_MAX_DOMAIN:
+            return K.sort_by_words(words)
+        # 128-aligned domain so near-identical spans share one compiled
+        # kernel (and one promotion verdict)
+        domain = -(-(hi + 1) // 128) * 128
+        interpret = jax.devices()[0].platform != "tpu"
+        if mode == "auto":
+            key = ("sort_perm", int(w.shape[0]), int(domain))
+            rec = self._promotion_rec(key)
+            if rec is None:
+                rec = self._measure_promotion(
+                    key,
+                    lambda: K.sort_by_words(words),
+                    lambda: PK.sort_perm_pallas(
+                        w, domain, interpret=interpret
+                    ),
+                    "sort_perm",
+                )
+            if not rec["use"]:
+                return K.sort_by_words(words)
+        return PK.sort_perm_pallas(w, domain, interpret=interpret)
+
     def _dense_build_route(self, rkey, rnn, rmin, table_cap):
         """Join-candidate build-table promotion (`engine.pallas_join`):
         `off` — the jnp scatter-max pair; `on` — the Pallas one-hot tile
@@ -2316,7 +2390,7 @@ class Executor:
         interpret = jax.devices()[0].platform != "tpu"
         if mode == "auto":
             key = ("dense_build", int(rkey.shape[0]), int(table_cap))
-            rec = session.pallas_promotions.get(key)
+            rec = self._promotion_rec(key)
             if rec is None:
                 rec = self._measure_promotion(
                     key,
@@ -2332,11 +2406,32 @@ class Executor:
             rkey, rnn, rmin, table_cap, interpret=interpret
         )
 
+    def _promotion_rec(self, key):
+        """The memoized promotion verdict for `key`: the session memo
+        first, then the PERSISTENT store (engine/aotcache.py
+        PromotionStore — verdicts measured by any previous process on
+        this backend environment), loaded into the memo on hit so a fleet
+        measures each (kernel, shape) once, ever. None = unmeasured."""
+        session = self.catalog.session
+        rec = session.pallas_promotions.get(key)
+        if rec is not None:
+            return rec
+        store = getattr(session, "promotion_store", None)
+        if store is None:
+            return None
+        rec = store.get(AOTC.promotion_key_str(key))
+        if rec is not None and "use" in rec:
+            with session.cache_lock:
+                session.pallas_promotions[key] = rec
+            return rec
+        return None
+
     def _measure_promotion(self, key, run_jnp, run_pallas, kname):
         """One-time measured A/B for a (kernel, shape) promotion slot:
         warm both paths (compiles land in the jit caches either way), time
-        one synchronized call each, memoize the winner on the session and
-        emit both measurements as `kernel_span` events."""
+        one synchronized call each, memoize the winner on the session
+        (and in the persistent promotion store when one is configured)
+        and emit both measurements as `kernel_span` events."""
         session = self.catalog.session
 
         def timed(run):
@@ -2358,6 +2453,15 @@ class Executor:
                 ),
                 "use": pallas_ms < jnp_ms,
             }
+        store = getattr(session, "promotion_store", None)
+        if store is not None:
+            # measure once, reuse forever: the verdict (keyed with the
+            # backend environment) outlives this process. The store is
+            # internally locked, but the mutation holds the session lock
+            # anyway — the cache-lock-discipline contract all session
+            # caches share
+            with session.cache_lock:
+                store.record(AOTC.promotion_key_str(key), rec)
         if self.tracer is not None:
             self.tracer.emit(
                 "kernel_span", kernel=f"{kname}:jnp",
@@ -2379,9 +2483,8 @@ class Executor:
         one synchronized call each; the Pallas route is used only where it
         measured faster. Both measurements emit `kernel_span` events so
         `profile` can show the promotion evidence per shape."""
-        session = self.catalog.session
         key = (fn, int(sdata.shape[0]), int(gcap))
-        rec = session.pallas_promotions.get(key)
+        rec = self._promotion_rec(key)
         if rec is None:
             # nds-lint: disable=local-import
             from ..ops import pallas_kernels as PK

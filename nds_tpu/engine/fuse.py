@@ -473,6 +473,94 @@ class _FusedBase:
         except Exception:
             return None, None
 
+    # -- AOT-cached execution ---------------------------------------------
+    def _init_aot(self, aot, fp, conf_sig, sample, kind: str,
+                  with_stats: bool):
+        """Arm persistent-executable resolution (engine/aotcache.py): the
+        base key half that is fixed at build time — pipeline kind, stage
+        fingerprint, content-stable input signature, relevant engine conf.
+        The per-bucket half (avals + donation slots) joins at dispatch.
+        `aot=None` keeps the classic in-process jit path untouched."""
+        self._aot = aot
+        self._aot_exec = {}  # (avals, slots) -> (compiled, from_disk)
+        if aot is None:
+            self._aot_base = None
+            return
+        self._aot_base = (
+            kind, fp, aot.content_signature(sample, with_stats=with_stats),
+            tuple(conf_sig or ()),
+        )
+
+    def _dispatch(self, flat, slots: tuple):
+        """Run the traced body over `flat` with `slots` donated.
+
+        Without an AOT cache this is the classic path: one jax.jit per
+        donation variant, executables keyed per shape bucket inside jax.
+        With one, every (avals, slots) bucket resolves its OWN compiled
+        executable — disk hit deserializes (a fresh process skips XLA
+        entirely), miss pays jit(fn).lower(avals).compile() ONCE and
+        serializes the result for every future process. A deserialized
+        executable that fails at call time is quarantined and replaced by
+        a fresh compile (never a crash, and donation-armed calls re-raise
+        instead of retrying over possibly-invalidated buffers)."""
+        if self._aot is None:
+            if slots:
+                jitted = self._jit_donate.get(slots)
+                if jitted is None:
+                    jitted = self._jit_donate[slots] = jax.jit(
+                        self._fn, donate_argnums=slots
+                    )
+                return jitted(*flat)
+            return self._jit(*flat)
+        avals = tuple((tuple(a.shape), str(a.dtype)) for a in flat)
+        rec = self._aot_exec.get((avals, slots))
+        if rec is None:
+            rec = self._aot_exec[(avals, slots)] = self._aot_resolve(
+                flat, slots, avals
+            )
+        compiled, from_disk = rec
+        try:
+            return compiled(*flat)
+        except Exception:
+            if not from_disk:
+                raise
+            # keyed correctly but unusable on this runtime (e.g. a stale
+            # serialization format): quarantine the entry so NO process
+            # (this one included) keeps loading it, and forget the dead
+            # in-memory rec so the next attempt recompiles fresh
+            self._aot.quarantine_key(self._aot_key(avals, slots))
+            self._aot_exec.pop((avals, slots), None)
+            if slots:
+                # the failed call may already have donated (invalidated)
+                # input buffers: a retry over them would read garbage —
+                # surface the failure (the ladder re-runs the query, which
+                # now compiles cleanly)
+                raise
+            compiled = self._aot_compile(flat, slots)
+            self._aot_exec[(avals, slots)] = (compiled, False)
+            return compiled(*flat)
+
+    def _aot_key(self, avals, slots) -> dict:
+        kind, fp, sig, conf_sig = self._aot_base
+        return self._aot.entry_key(kind, fp, sig, avals, slots, conf_sig)
+
+    def _aot_compile(self, flat, slots):
+        specs = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in flat]
+        return jax.jit(
+            self._fn, donate_argnums=slots or ()
+        ).lower(*specs).compile()
+
+    def _aot_resolve(self, flat, slots, avals):
+        """(compiled, from_disk) for one (avals, slots) bucket: disk load
+        first, else compile + persist."""
+        key = self._aot_key(avals, slots)
+        compiled = self._aot.load(key)
+        if compiled is not None:
+            return compiled, True
+        compiled = self._aot_compile(flat, slots)
+        self._aot.store(key, compiled)
+        return compiled, False
+
     def _donate_slots(self, table: Table, flat) -> tuple:
         """Flat arg indices safe AND useful to donate for THIS call: the
         consumed live-mask input (the plan rewrite's donate_ok gate already
@@ -549,7 +637,13 @@ class FusedPipeline(_FusedBase):
     trace raises, and the ExecutableCache pins its signature to the eager
     path."""
 
-    def __init__(self, stages, sample: Table):
+    def __init__(self, stages, sample: Table, aot=None, fp=None,
+                 conf_sig=()):
+        """aot/fp/conf_sig: persistent-executable resolution
+        (engine/aotcache.py) — `aot` is the session AotCache (or None for
+        the classic jit path), `fp` the pipeline's stage fingerprint, and
+        `conf_sig` the compiled-code-relevant engine conf values that
+        join the on-disk entry key."""
         self.stages = stages
         self._capture_inputs(sample)
         self.has_filter = any(isinstance(s, P.Filter) for s in stages)
@@ -574,8 +668,11 @@ class FusedPipeline(_FusedBase):
         self._consumed, self._out_avals = self._analyze_donation(
             self._run_kept, self._input_specs(sample), sample.cap
         )
+        self._fn = self._run_kept
         self._jit = jax.jit(self._run_kept)
         self._jit_donate = {}  # donate-slot tuple -> jitted callable
+        self._init_aot(aot, fp, conf_sig, sample, "pipeline",
+                       with_stats=False)
 
     # -- traced body ------------------------------------------------------
     def _run_full(self, *flat):
@@ -615,15 +712,7 @@ class FusedPipeline(_FusedBase):
     def call(self, table: Table, donate: bool) -> Table:
         flat = self._flat_args(table)
         slots = self._donate_slots(table, flat) if donate else ()
-        if slots:
-            jitted = self._jit_donate.get(slots)
-            if jitted is None:
-                jitted = self._jit_donate[slots] = jax.jit(
-                    self._run_kept, donate_argnums=slots
-                )
-            out = jitted(*flat)
-        else:
-            out = self._jit(*flat)
+        out = self._dispatch(flat, slots)
         # reassemble: computed slots from the executable, passthrough
         # slots straight from the caller's own buffers
         full = [None] * len(self.passthrough)
@@ -718,7 +807,8 @@ class FusedAggPipeline(_FusedBase):
     the direct-aggregation cap, or an argument cannot trace — the exact
     inputs the eager path would route to its sort-based aggregation."""
 
-    def __init__(self, stages, agg: P.Aggregate, sample: Table):
+    def __init__(self, stages, agg: P.Aggregate, sample: Table, aot=None,
+                 fp=None, conf_sig=()):
         self.stages = stages
         self.agg = agg
         self._capture_inputs(sample)
@@ -751,8 +841,14 @@ class FusedAggPipeline(_FusedBase):
         self._consumed, self._out_avals = self._analyze_donation(
             self._run_agg, specs, sample.cap
         )
+        self._fn = self._run_agg
         self._jit = jax.jit(self._run_agg)
         self._jit_donate = {}
+        # stats fold into the content signature: the mixed-radix bounds
+        # bake into the trace, so a dataset with different bounds is a
+        # different executable on disk too
+        self._init_aot(aot, fp, conf_sig, sample, "agg_pipeline",
+                       with_stats=True)
 
     # -- build ------------------------------------------------------------
     def _probe_keys(self, *flat):
@@ -883,15 +979,7 @@ class FusedAggPipeline(_FusedBase):
     def call(self, table: Table, donate: bool) -> Table:
         flat = self._flat_args(table)
         slots = self._donate_slots(table, flat) if donate else ()
-        if slots:
-            jitted = self._jit_donate.get(slots)
-            if jitted is None:
-                jitted = self._jit_donate[slots] = jax.jit(
-                    self._run_agg, donate_argnums=slots
-                )
-            out = jitted(*flat)
-        else:
-            out = self._jit(*flat)
+        out = self._dispatch(flat, slots)
         in_cols = list(table.columns.values())
         if not self.agg.keys:
             # global aggregate: exactly one output row (cell 0), over empty

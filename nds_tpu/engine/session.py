@@ -63,7 +63,16 @@ def _enable_persistent_compile_cache():
 
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+        # NDS_XLA_CACHE_MIN_COMPILE_S=0 persists even sub-100ms kernel
+        # compiles — the cold-start gate (tools/fuse_microbench.py) and
+        # fleets whose cold cost is MANY small kernels want everything on
+        # disk; the 0.1 s default keeps steady-state dev runs from
+        # churning the cache with trivial entries
+        min_s = os.environ.get("NDS_XLA_CACHE_MIN_COMPILE_S")
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs",
+            float(min_s) if min_s else 0.1,
+        )
     except Exception:
         pass  # older jax without the knobs: in-memory cache only
 
@@ -659,6 +668,39 @@ class Session:
         self.exec_cache = ExecutableCache(
             int(self.conf.get("engine.exec_cache_entries", 512))
         )
+        # persistent AOT executable cache (engine/aotcache.py): fused
+        # pipelines resolve per-bucket compiled executables through it, so
+        # a FRESH PROCESS deserializes from disk instead of recompiling —
+        # cold start is paid once per environment, ever. Single-device
+        # sessions only: under a mesh the inputs are sharded and the
+        # lowered-without-shardings avals would not describe them; and
+        # multi-process loads cannot target non-addressable devices.
+        # Disable with NDS_AOT_CACHE_DIR=0 / engine.aot_cache_dir="".
+        from .aotcache import (
+            AotCache,
+            PromotionStore,
+            resolve_aot_cache_bytes,
+            resolve_aot_cache_dir,
+            sweep_at_session_start as _aot_sweep,
+        )
+
+        self.aot_cache = None
+        self.promotion_store = None
+        _aot_dir = resolve_aot_cache_dir(self.conf)
+        if _aot_dir:
+            # promotion memos persist even where executables cannot (the
+            # verdicts are keyed by backend environment, not by sharding)
+            self.promotion_store = PromotionStore(_aot_dir)
+            if mesh is None:
+                import jax as _jax
+
+                if _jax.process_count() == 1:
+                    _aot_sweep(_aot_dir)
+                    self.aot_cache = AotCache(
+                        _aot_dir,
+                        resolve_aot_cache_bytes(self.conf, _aot_dir),
+                        tracer=lambda: self.tracer,
+                    )
         # stats of the most recent blocked union-aggregation any executor
         # of this session ran (bench.py's OOM-bail heuristic reads it)
         self.last_blocked_union = None

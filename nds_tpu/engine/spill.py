@@ -91,11 +91,28 @@ def resolve_spill_dir(conf: dict | None = None) -> str | None:
     return v if v not in ("", "0") else None
 
 
+#: `auto` pool sizing: 1/4 of physical host RAM, power-of-two, clamped —
+#: the same share-of-a-resource derivation the union window applies to the
+#: device budget (analysis/budget.derive_share_bytes; ROADMAP item 2's
+#: carry-forward: SF100 working sets need the pool sized to the HOST, not
+#: to a fixed 4 GiB constant)
+_AUTO_POOL_FRACTION = 4
+_AUTO_POOL_LO = 1 << 30
+_AUTO_POOL_HI = 64 << 30
+
+
 def resolve_pool_bytes(conf: dict | None = None) -> int:
     v = None
     if conf:
         v = conf.get("engine.spill_pool_bytes")
     v = v if v is not None else os.environ.get("NDS_SPILL_POOL_BYTES")
+    if v is not None and str(v).lower() == "auto":
+        from ..analysis.budget import derive_share_bytes, host_ram_bytes
+
+        return derive_share_bytes(
+            host_ram_bytes(), _AUTO_POOL_FRACTION,
+            _AUTO_POOL_LO, _AUTO_POOL_HI,
+        )
     try:
         return max(int(v), 0) if v is not None and v != "" else DEFAULT_POOL_BYTES
     except (TypeError, ValueError):

@@ -55,6 +55,9 @@ METRIC_KINDS = {
     "nds_plan_cache_total": "plan_cache",
     "nds_catalog_load_total": "catalog_load",
     "nds_exec_cache_total": "exec_cache",
+    "nds_aot_cache_total": "aot_cache",
+    "nds_aot_cache_bytes_total": "aot_cache",
+    "nds_aot_cache_ms_total": "aot_cache",
     "nds_pipeline_span_total": "pipeline_span",
     "nds_kernel_span_total": "kernel_span",
     "nds_kernel_span_ms_total": "kernel_span",
@@ -385,6 +388,19 @@ class MetricsSink:
             "nds_exec_cache_total", result="hit" if ev.get("hit") else "miss"
         )
 
+    def _h_aot_cache(self, ev):
+        op = str(ev.get("op"))
+        result = str(ev.get("result"))
+        self.registry.inc("nds_aot_cache_total", op=op, result=result)
+        if ev.get("bytes") is not None:
+            self.registry.inc(
+                "nds_aot_cache_bytes_total", int(ev["bytes"]), op=op
+            )
+        if ev.get("dur_ms") is not None:
+            self.registry.inc(
+                "nds_aot_cache_ms_total", float(ev["dur_ms"]), op=op
+            )
+
     def _h_pipeline_span(self, ev):
         self.registry.inc(
             "nds_pipeline_span_total",
@@ -566,6 +582,7 @@ _HANDLERS = {
     "plan_cache": MetricsSink._h_plan_cache,
     "catalog_load": MetricsSink._h_catalog_load,
     "exec_cache": MetricsSink._h_exec_cache,
+    "aot_cache": MetricsSink._h_aot_cache,
     "pipeline_span": MetricsSink._h_pipeline_span,
     "kernel_span": MetricsSink._h_kernel_span,
     "blocked_union": MetricsSink._h_blocked_union,

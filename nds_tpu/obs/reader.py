@@ -285,6 +285,11 @@ def profile_events(events) -> dict:
         "lake_vacuum_files": 0,
         "exec_cache_hits": 0,
         "exec_cache_misses": 0,
+        "aot_disk_hits": 0,
+        "aot_misses": 0,
+        "aot_stores": 0,
+        "aot_quarantined": 0,
+        "aot_evictions": 0,
         "pipelines_fused": 0,
         "pipelines_eager": 0,
         "mem_watermarks": 0,
@@ -349,6 +354,19 @@ def profile_events(events) -> dict:
             tallies[
                 "exec_cache_hits" if ev.get("hit") else "exec_cache_misses"
             ] += 1
+        elif k == "aot_cache":
+            op, result = ev.get("op"), ev.get("result")
+            if op == "load":
+                if result == "hit":
+                    tallies["aot_disk_hits"] += 1
+                elif result == "quarantined":
+                    tallies["aot_quarantined"] += 1
+                else:
+                    tallies["aot_misses"] += 1
+            elif op == "store" and result == "stored":
+                tallies["aot_stores"] += 1
+            elif op == "evict":
+                tallies["aot_evictions"] += int(ev.get("entries") or 0)
         elif k == "pipeline_span":
             tallies[
                 "pipelines_fused" if ev.get("fused") else "pipelines_eager"
@@ -390,6 +408,19 @@ def exec_cache_hit_rate(prof: dict):
     if probes == 0:
         return None
     return t["exec_cache_hits"] / probes
+
+
+def aot_disk_hit_rate(prof: dict):
+    """Persistent-executable-cache disk hit rate of a profiled run, or
+    None when no aot_cache load probes were recorded (cache disabled /
+    untraced). The two-process microbench gate in tools/fuse_microbench.py
+    reads this from the FRESH process's trace: a warmed fleet's cold
+    dispatches must resolve from disk, not recompile."""
+    t = prof["tallies"]
+    probes = t.get("aot_disk_hits", 0) + t.get("aot_misses", 0)
+    if probes == 0:
+        return None
+    return t.get("aot_disk_hits", 0) / probes
 
 
 # ---------------------------------------------------------------------------

@@ -71,6 +71,14 @@ EVENT_SCHEMA = {
     # executable-cache probe for a pipeline (hit=True: an executable for
     # this (structure, dtypes, bucket) already existed this session)
     "exec_cache": ("pipeline", "bucket", "hit"),
+    # persistent AOT executable cache activity (engine/aotcache.py):
+    # op "load" (result hit | miss | key_mismatch | quarantined), "store"
+    # (stored | io_error | unserializable), "evict", "vacuum". Optional:
+    # bytes, dur_ms, key, entries, removed, error. A `load`/`hit` event in
+    # a fresh process is the trace-level evidence an executable came from
+    # disk instead of a recompile (the two-process microbench gate reads
+    # exactly this).
+    "aot_cache": ("op", "result"),
     # a fault-injection rule fired (faults.FaultRegistry)
     "fault_injected": ("site", "fault_kind"),
     # one degradation-ladder rung taken (BenchReport)

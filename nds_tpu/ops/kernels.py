@@ -245,6 +245,17 @@ def kv_sort_perm(key: jnp.ndarray) -> jnp.ndarray:
     return _kv_sort_perm(key.astype(I64))
 
 
+@partial(jax.jit, static_argnames=())
+def word_span(word: jnp.ndarray):
+    """(min, max) over ONE sort word, padding included — the span probe
+    for the Pallas counting-sort route (exec._sort_perm_route): every
+    value in the word (live, dead, and null codes alike) is a legitimate
+    sort key, so the span must cover them all. One fused dispatch; the
+    caller pays the single host sync."""
+    w = word.astype(I64)
+    return jnp.stack([jnp.min(w), jnp.max(w)])
+
+
 @_ktraced("sort_by_words")
 def sort_by_words(words) -> jnp.ndarray:
     """Stable lexicographic argsort by a list of int64 words (most

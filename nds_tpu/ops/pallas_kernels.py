@@ -237,6 +237,117 @@ def dense_build_pallas(rkey, rlive, rmin, table_cap: int,
     return out[0, :table_cap] > 0, out[1, :table_cap]
 
 
+#: counting-sort routing caps (exec._sort_perm_route gates on them): the
+#: one-hot rank tile holds the whole (padded) domain in VMEM, and ranks
+#: accumulate in f32 (exact to 2**24 — matmul counts of 0/1 entries)
+SORT_ROW_TILE = 256
+SORT_MAX_DOMAIN = 2048
+SORT_MAX_ROWS = 1 << 24
+
+
+def _sort_rank_kernel(vals_ref, rank_ref, hist_ref):
+    """One row tile of the stable counting-rank: rank[r] = (# rows with
+    the same key in PREVIOUS tiles) + (# earlier rows with the same key in
+    THIS tile). The running per-key histogram rides the hist output block
+    (revisited across the sequential grid, the same accumulation pattern
+    as the segment kernels); its final state is the key histogram the
+    caller turns into counting-sort offsets."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        hist_ref[:] = jnp.zeros_like(hist_ref)
+
+    t = vals_ref.shape[1]
+    g = hist_ref.shape[1]
+    vals = vals_ref[0, :]
+    cols = jax.lax.broadcasted_iota(jnp.int32, (t, g), 1)
+    onehot = (vals.reshape(t, 1) == cols).astype(jnp.float32)
+    carry = hist_ref[0, :]
+    # rank contribution from previous tiles: each row gathers its key's
+    # running count via its one-hot row (a (t,g)x(g,1) matmul-gather)
+    prev = jnp.dot(
+        onehot, carry.reshape(g, 1),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )[:, 0]
+    # within-tile stable rank: strictly-lower-triangular ones L gives
+    # (L @ onehot)[r, key] = earlier same-key rows; gather own column
+    rows_i = jax.lax.broadcasted_iota(jnp.int32, (t, t), 0)
+    cols_i = jax.lax.broadcasted_iota(jnp.int32, (t, t), 1)
+    tril = (cols_i < rows_i).astype(jnp.float32)
+    la = jnp.dot(
+        tril, onehot,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    within = jnp.sum(la * onehot, axis=1)
+    rank_ref[0, :] = prev + within
+    new_hist = carry + jnp.sum(onehot, axis=0)
+    hist_ref[:] = jnp.concatenate(
+        [new_hist.reshape(1, -1), jnp.zeros((7, g), jnp.float32)]
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("domain", "interpret"))
+def sort_rank_pallas(vals, domain: int, interpret: bool = False):
+    """(stable within-key rank f32[n], key histogram f32[domain]) of int32
+    `vals` in [0, domain); -1 marks a padded lane (contributes nothing,
+    rank output unspecified). domain <= SORT_MAX_DOMAIN (the one-hot tile
+    holds the whole padded domain), n <= SORT_MAX_ROWS (f32-exact
+    counts)."""
+    n = vals.shape[0]
+    g = -(-max(domain, 128) // 128) * 128
+    if n == 0:
+        return jnp.zeros(0, jnp.float32), jnp.zeros(domain, jnp.float32)
+    t = -(-max(128, min(SORT_ROW_TILE, n)) // 128) * 128
+    n_pad = -(-n // t) * t
+    vals = jnp.pad(
+        vals.astype(jnp.int32), (0, n_pad - n), constant_values=-1
+    )
+    rank, hist = pl.pallas_call(
+        _sort_rank_kernel,
+        grid=(n_pad // t,),
+        in_specs=[pl.BlockSpec((1, t), lambda i: (i, 0))],
+        out_specs=[
+            pl.BlockSpec((1, t), lambda i: (i, 0)),
+            pl.BlockSpec((8, g), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_pad // t, t), jnp.float32),
+            jax.ShapeDtypeStruct((8, g), jnp.float32),
+        ],
+        interpret=interpret,
+    )(vals.reshape(-1, t))
+    return rank.reshape(-1)[:n], hist[0, :domain]
+
+
+@functools.partial(jax.jit, static_argnames=("domain", "interpret"))
+def sort_perm_pallas(word, domain: int, interpret: bool = False):
+    """Stable ascending argsort of one small-domain sort word — the
+    Pallas counting-sort counterpart of the canonical kv-sort kernel
+    (kernels._kv_sort_perm), for words whose packed value span fits
+    SORT_MAX_DOMAIN (dictionary codes, tight date spans, the common
+    TPC-DS ORDER BY shapes). Identical permutation to the canonical
+    kernel by construction: both are stable ascending, and counting-sort
+    position = offset[key] + stable within-key rank. XLA:TPU lax.sort
+    compiles a fresh comparator kernel per operand/shape tuple and runs a
+    serial bitonic network; this path is two MXU one-hot matmuls per row
+    tile plus one collision-free scatter."""
+    n = word.shape[0]
+    vals = word.astype(jnp.int32)
+    rank, hist = sort_rank_pallas(vals, domain, interpret=interpret)
+    counts = hist.astype(jnp.int32)
+    offsets = jnp.cumsum(counts) - counts  # exclusive prefix
+    pos = offsets[jnp.clip(vals, 0, domain - 1)] + rank.astype(jnp.int32)
+    # positions are unique by construction: the scatter is collision-free
+    return (
+        jnp.zeros(n, jnp.int32)
+        .at[pos]
+        .set(jnp.arange(n, dtype=jnp.int32), mode="drop")
+    )
+
+
 def segment_sums(vals, gid, n_groups: int):
     """Dispatch: MXU one-hot matmul kernel on TPU, XLA scatter elsewhere."""
     if jax.devices()[0].platform == "tpu":
