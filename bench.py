@@ -608,6 +608,94 @@ def main():
             OUT["maintenance_under_load"] = {"error": str(exc)[:500]}
         emit()
 
+    if os.environ.get("NDS_BENCH_SERVE"):
+        # opt-in serve block (NDS_BENCH_SERVE=1): the closed-loop
+        # multi-client QPS x p99 scenario (tools/serve_bench.py) beside
+        # the TPC-DS composite — point lookups + heavy aggregates + DM
+        # writes against the serve endpoint, snapshot-consistency
+        # asserted per response. Fail-soft like the block above.
+        try:
+            OUT["serve"] = bench_serve()
+        except Exception as exc:
+            OUT["serve"] = {"error": str(exc)[:500]}
+        emit()
+
+    # carry-forward hygiene (ROADMAP): every round auto-compares its
+    # sqlite_shared headline against the newest stored BENCH_r*.json via
+    # the profiler's --bench comparison, instead of relying on someone
+    # remembering the manual `profile --bench OLD NEW` invocation
+    compare_against_baseline()
+    emit()
+
+
+def bench_serve():
+    """Run tools/serve_bench.run_bench (in-process, ephemeral port) over
+    the marker-cached SF0.01 lakehouse and return the compact headline
+    fields. Knobs: NDS_BENCH_SERVE_CLIENTS (4), NDS_BENCH_SERVE_DURATION
+    seconds (30)."""
+    import importlib.util
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    spec = importlib.util.spec_from_file_location(
+        "serve_bench", os.path.join(here, "tools", "serve_bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    r = mod.run_bench(
+        clients=int(os.environ.get("NDS_BENCH_SERVE_CLIENTS", "4")),
+        duration_s=float(os.environ.get("NDS_BENCH_SERVE_DURATION", "30")),
+    )
+    DETAIL["serve"] = r
+    return {
+        k: r.get(k)
+        for k in (
+            "qps", "p50_ms", "p99_ms", "scraped_p99_ms", "requests",
+            "completed", "http_5xx", "rejected_429", "snapshot_violations",
+            "dm_commits", "wall_s", "clients", "workers",
+        )
+    }
+
+
+def compare_against_baseline():
+    """Auto round comparison: diff this run's sqlite_shared headline
+    against the stored baseline round (NDS_BENCH_BASELINE, else the
+    newest BENCH_r*.json next to this script) through the same
+    `profile --bench` comparison the manual invocation uses. Fail-soft:
+    a malformed baseline must never cost the round its metrics."""
+    try:
+        import glob
+        import tempfile
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        base = os.environ.get("NDS_BENCH_BASELINE")
+        if not base:
+            rounds = sorted(glob.glob(os.path.join(here, "BENCH_r*.json")))
+            base = rounds[-1] if rounds else None
+        if not base or not OUT.get("sqlite_shared"):
+            return
+        from nds_tpu.cli.profile import _compare_sqlite_shared
+
+        fd, tmp = tempfile.mkstemp(suffix=".json")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(OUT, f)
+            recs = _compare_sqlite_shared(base, tmp)
+        finally:
+            os.unlink(tmp)
+        rec = next(
+            (r for r in recs if r.get("change") in ("headline", "regression")),
+            None,
+        )
+        if rec is not None:
+            OUT["baseline_compare"] = {
+                "baseline": os.path.basename(base),
+                "old_ratio": rec.get("old_ratio"),
+                "new_ratio": rec.get("new_ratio"),
+                "regressed": rec.get("change") == "regression",
+            }
+    except Exception as exc:
+        OUT["baseline_compare"] = {"error": str(exc)[:200]}
+
 
 def bench_maintenance_under_load():
     """Maintenance-under-load at SF0.01 (NDS_BENCH_MAINT_UNDER_LOAD=1):

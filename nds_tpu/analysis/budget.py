@@ -354,6 +354,38 @@ def derive_share_bytes(total_bytes: int, fraction: int,
     return int(min(max(pow2, lo), hi))
 
 
+#: serve-mode admission sizing: one concurrently admitted request is
+#: assumed to transiently hold up to this much device working set beyond
+#: the catalog residency (an admitted-direct statement's modeled peak is
+#: bounded by the budget line; slots = budget // this, so full occupancy
+#: stays inside the same working-set budget single-stream admission uses)
+SERVE_SLOT_BYTES = 1 << 30
+
+
+def serve_concurrency(conf: Optional[dict] = None) -> int:
+    """Admission slots (= worker-pool size) for `nds-tpu-submit serve`.
+
+    `engine.serve_workers` / NDS_SERVE_WORKERS overrides; otherwise the
+    count derives from the SAME working-set budget the plan budgeter
+    admits statements against (`resolve_budget_bytes`): one slot per
+    SERVE_SLOT_BYTES of budget, clamped to [1, 16]. The default 4 GiB
+    budget therefore carries 4 concurrent requests — sized so the sum of
+    concurrently admitted working sets stays inside what one admitted
+    batch statement could have used alone."""
+    v = None
+    if conf:
+        v = conf.get("engine.serve_workers")
+    if v is None:
+        v = os.environ.get("NDS_SERVE_WORKERS")
+    if v:
+        try:
+            return max(int(v), 1)
+        except (TypeError, ValueError):
+            pass
+    budget = resolve_budget_bytes(conf)
+    return int(min(max(budget // SERVE_SLOT_BYTES, 1), 16))
+
+
 def host_ram_bytes() -> int:
     """Physical host RAM in bytes (sysconf), falling back to a 16 GiB
     assumption on platforms without the counters — the `auto` budget

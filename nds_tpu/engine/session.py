@@ -347,6 +347,14 @@ class Catalog:
             lake_version is not None
             and e.fmt == "lakehouse"
             and e.pinned_version != lake_version
+            # FORWARD-only re-pin: a plan AHEAD of the entry (a fresh
+            # statement after a commit) moves the shared pin up. A plan
+            # BEHIND it (another statement already advanced the shared
+            # entry on this serve/throughput session) must NOT yank the
+            # pin — and the newer pin's lease + device cache — backward
+            # out from under the newer statements: it reads its own
+            # older snapshot DETACHED below, under its own lease.
+            and (e.pinned_version is None or lake_version > e.pinned_version)
         ):
             self.pin_lakehouse(name, version=lake_version)
         self._use_tick += 1
@@ -378,11 +386,22 @@ class Catalog:
             and (snap is None or snap.version != lake_version)
         )
         if detached:
+            from ..lakehouse.leases import LEASES, resolve_lease_ttl
             from ..lakehouse.table import LakehouseTable
 
-            snap = LakehouseTable(
-                e.path, conf=self.session.conf
-            ).snapshot(lake_version)
+            lt = LakehouseTable(e.path, conf=self.session.conf)
+            snap = lt.snapshot(lake_version)
+            # a detached read is not covered by the entry's lease (that
+            # belongs to the entry's pin, possibly a different version):
+            # register its own TTL-bounded lease BEFORE reading so a
+            # concurrent vacuum cannot delete this snapshot's files
+            # mid-scan. No release point exists (the statement may keep
+            # re-loading), so expiry is the TTL's job — the lease
+            # table's documented leak bound.
+            LEASES.acquire(
+                lt.root, snap.version, snap.rel_files,
+                resolve_lease_ttl(self.session.conf),
+            )
         missing = (
             list(columns) if detached
             else [c for c in columns if c not in e.device_cols]
@@ -570,14 +589,19 @@ class Result:
         self.executor = None  # kept so callers can read per-query stats
         # (e.g. last_blocked_union) without racing other sessions' threads
 
-    def table(self) -> Table:
+    def table(self, tracer=None) -> Table:
+        """Execute (memoized). `tracer` overrides the executor's event
+        destination for THIS execution — serve mode passes a per-request
+        forwarding tracer so every op_span/exec_cache event carries the
+        request id + tenant instead of aliasing across concurrent
+        requests on the shared session."""
         if self._table is None:
-            self.executor = self.session._executor()
+            self.executor = self.session._executor(tracer=tracer)
             self._table = self.executor.execute(self.plan)
         return self._table
 
-    def collect(self) -> pa.Table:
-        return table_to_arrow(self.table())
+    def collect(self, tracer=None) -> pa.Table:
+        return table_to_arrow(self.table(tracer=tracer))
 
     def to_pylist(self):
         return self.collect().to_pylist()
@@ -925,12 +949,50 @@ class Session:
             cb(reason)
 
     # ---- SQL -------------------------------------------------------------
-    def _executor(self):
-        return Executor(self.catalog, on_task_failure=self.notify_failure)
+    def _executor(self, tracer=None):
+        return Executor(
+            self.catalog, on_task_failure=self.notify_failure, tracer=tracer
+        )
 
     def sql(self, text: str) -> Result:
         stmt = parse_sql(text)
         return self.run_stmt(stmt)
+
+    def plan_sql(self, text: str):
+        """Parse + plan ONE SELECT statement atomically with respect to
+        every other planner on this session, returning
+        `(Result, plan-budget record)`.
+
+        Serve mode's admission path needs the budgeter verdict that
+        belongs to THIS statement: `last_plan_budget` is a single field
+        on a session shared across concurrent tenants, so planning and
+        verdict capture must be one critical section (held under
+        `cache_lock`, the same lock the plan caches already take) or two
+        requests could read each other's verdicts. Execution stays
+        outside the lock — only planning serializes. A `reject` verdict
+        raises PlanBudgetError out of here, BEFORE anything dispatches
+        (the serve 429 path)."""
+        stmts = parse_script(text)
+        if len(stmts) != 1 or not isinstance(stmts[0], A.SelectStmt):
+            raise ValueError(
+                "plan_sql takes exactly one SELECT statement "
+                f"(got {len(stmts)} statement(s))"
+            )
+        return self.plan_stmt(stmts[0])
+
+    def plan_stmt(self, stmt):
+        """`plan_sql` over an already-parsed SELECT statement — callers
+        that parsed the text to classify it (serve's SELECT-vs-DML
+        routing) must not pay a second parse inside the one lock that
+        serializes every tenant's planning."""
+        if not isinstance(stmt, A.SelectStmt):
+            raise ValueError(
+                f"plan_stmt wants a SELECT, got {type(stmt).__name__}"
+            )
+        with self.cache_lock:
+            res = self.run_stmt(stmt)
+            rec = self.last_plan_budget
+            return res, (dict(rec) if isinstance(rec, dict) else None)
 
     def run_script(self, text: str):
         out = None

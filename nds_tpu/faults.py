@@ -34,6 +34,10 @@ Injection sites fired around the codebase:
     manifest:<table_name> lakehouse manifest read (io/crash kinds only)
     vacuum:<table_name>   lakehouse vacuum delete (io/crash kinds only)
     <phase_name>          full_bench phase runner (e.g. power_test)
+    serve:admit           serve-mode admission path (request is SHED 429,
+                          never the server)
+    serve:exec            serve-mode request execution (walks the same
+                          BenchReport ladder a bench query would)
     any path substring    fs_open (fired via maybe_fire_path)
 
 The registry is a module singleton; when no spec is installed every

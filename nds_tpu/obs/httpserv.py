@@ -1,4 +1,5 @@
-"""The live-telemetry HTTP endpoint: /metrics, /statusz, /healthz.
+"""The live-telemetry HTTP endpoint: /metrics, /statusz, /healthz — and,
+in serve mode, the query-service routes on the SAME listener.
 
 A stdlib `http.server` ThreadingHTTPServer on a daemon thread — no new
 dependency, nothing to install on a fleet node. Started by
@@ -9,13 +10,23 @@ back from `MetricsServer.port`).
     GET /metrics   Prometheus text exposition of the registry
     GET /statusz   JSON run status: current phase, in-flight query with
                    elapsed/attempt/ladder, completed/failed counts, cache
-                   hit rates, RSS + memory high-water, heartbeat age
-    GET /healthz   "ok" (liveness only; /statusz is the readiness story)
+                   hit rates, RSS + memory high-water, heartbeat age (and
+                   per-tenant serve stats when serve mode is attached)
+    GET /healthz   "ok" liveness; 503 "draining" once a serve-mode drain
+                   begins, so load balancers stop routing BEFORE shutdown
 
-The handler only READS sink state (every read path takes the sink's own
-locks), so a scrape can never block or corrupt the run it watches; the
-server thread is a daemon, so a finished benchmark process never hangs
-on it."""
+Serve mode (`nds_tpu/serve/`) attaches an application via `attach_app`:
+any route the built-ins above don't own is dispatched to
+`app.handle_http(method, path, headers, body)` — POST /query, /stream,
+/drain, /reload, GET /jobs/<id> all ride this one process-wide listener
+instead of binding a second port. POST bodies are size-capped, and a
+per-connection read timeout bounds what a slow (or slowloris) client can
+hold: a stalled socket times out and closes, never wedging a worker.
+
+The built-in handlers only READ sink state (every read path takes the
+sink's own locks), so a scrape can never block or corrupt the run it
+watches; the server thread is a daemon, so a finished benchmark process
+never hangs on it."""
 
 from __future__ import annotations
 
@@ -24,17 +35,42 @@ import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+#: largest accepted POST body (a query request is SQL text + a small JSON
+#: envelope; anything bigger is a client bug or a flood)
+MAX_BODY_BYTES = 8 << 20
+
 
 class _Handler(BaseHTTPRequestHandler):
     server_version = "nds-tpu-metrics"
+    # slow-client guard: BaseHTTPRequestHandler applies this as the
+    # connection's socket timeout, so a client that stops sending (or
+    # never sends) its request gets its connection closed instead of
+    # holding a handler thread forever (the slowloris scenario)
+    timeout = float(os.environ.get("NDS_SERVE_CLIENT_TIMEOUT_S", "60"))
 
-    def _reply(self, code, body, ctype):
-        data = body.encode("utf-8")
+    def _reply(self, code, body, ctype, headers=()):
+        data = body.encode("utf-8") if isinstance(body, str) else body
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
+        for k, v in headers:
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
+
+    def _dispatch_app(self, method, path, body):
+        """Route a non-built-in path to the attached serve app (if any).
+        Returns True when the app owned the route."""
+        app = getattr(self.server, "app", None)
+        if app is None:
+            return False
+        headers = {k.lower(): v for k, v in self.headers.items()}
+        result = app.handle_http(method, path, headers, body)
+        if result is None:
+            return False
+        status, ctype, payload, extra = result
+        self._reply(status, payload, ctype, extra)
+        return True
 
     def do_GET(self):  # noqa: N802 (BaseHTTPRequestHandler contract)
         sink = self.server.sink
@@ -51,11 +87,69 @@ class _Handler(BaseHTTPRequestHandler):
                     "application/json",
                 )
             elif path == "/healthz":
-                self._reply(200, "ok\n", "text/plain; charset=utf-8")
-            else:
+                app = getattr(self.server, "app", None)
+                if app is not None and getattr(app, "draining", False):
+                    # the load-balancer signal: stop routing here — the
+                    # process is still alive (200s keep flowing on
+                    # /metrics) but it is on its way out
+                    self._reply(
+                        503, "draining\n", "text/plain; charset=utf-8",
+                        (("Retry-After", "5"),),
+                    )
+                else:
+                    self._reply(200, "ok\n", "text/plain; charset=utf-8")
+            elif not self._dispatch_app("GET", path, None):
                 self._reply(404, "not found\n", "text/plain; charset=utf-8")
         except BrokenPipeError:
             pass  # scraper hung up mid-reply: its problem, not the run's
+        except Exception as exc:  # app bug: a JSON 500, not a socket reset
+            self._internal_error(exc)
+
+    def do_POST(self):  # noqa: N802 (BaseHTTPRequestHandler contract)
+        path = self.path.split("?", 1)[0]
+        try:
+            try:
+                # clamp below zero: a negative Content-Length would turn
+                # rfile.read(length) into read-to-EOF, voiding the cap
+                length = max(int(self.headers.get("Content-Length") or 0), 0)
+            except ValueError:
+                length = 0
+            if length > MAX_BODY_BYTES:
+                self._reply(
+                    413, "request body too large\n",
+                    "text/plain; charset=utf-8",
+                )
+                return
+            body = self.rfile.read(length) if length else b""
+            try:
+                handled = self._dispatch_app("POST", path, body)
+            except ValueError as exc:  # malformed JSON body
+                self._reply(
+                    400, json.dumps({"error": str(exc)}), "application/json"
+                )
+                return
+            if not handled:
+                self._reply(404, "not found\n", "text/plain; charset=utf-8")
+        except BrokenPipeError:
+            # mid-query disconnect: the engine work (if any) completes on
+            # its worker; only this connection's reply is lost
+            pass
+        except Exception as exc:  # app bug: a JSON 500, not a socket reset
+            self._internal_error(exc)
+
+    def _internal_error(self, exc):
+        """An exception escaping the attached app must still answer the
+        client (otherwise the connection just resets with no status
+        line); the body carries the exception TYPE only — messages can
+        embed paths/SQL a multi-tenant endpoint must not leak."""
+        try:
+            self._reply(
+                500,
+                json.dumps({"error": f"internal: {type(exc).__name__}"}),
+                "application/json",
+            )
+        except OSError:
+            pass  # client already gone
 
     def log_message(self, format, *args):  # noqa: A002 (stdlib signature)
         pass  # a scrape every few seconds must not spam the bench stdout
@@ -74,9 +168,16 @@ class MetricsServer:
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.sink = sink
+        self._httpd.app = None
         self.host = host
         self.port = self._httpd.server_address[1]
         self._thread = None
+
+    def attach_app(self, app):
+        """Attach a serve-mode application: routes the built-in telemetry
+        paths don't own dispatch to `app.handle_http`, and /healthz reads
+        `app.draining`. One listener, one port, the whole surface."""
+        self._httpd.app = app
 
     def start(self) -> "MetricsServer":
         self._thread = threading.Thread(

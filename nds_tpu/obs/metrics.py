@@ -83,6 +83,11 @@ METRIC_KINDS = {
     "nds_heartbeat_total": "heartbeat",
     "nds_heartbeat_rss_bytes": "heartbeat",         # gauge (latest)
     "nds_heartbeat_elapsed_ms": "heartbeat",        # gauge (latest)
+    "nds_serve_request_total": "serve_request",
+    "nds_serve_request_ms_total": "serve_request",
+    "nds_serve_request_dur_ms": "serve_request",    # histogram (p99 scrape)
+    "nds_serve_request_rows_total": "serve_request",
+    "nds_serve_request_bytes_total": "serve_request",
 }
 
 #: bounded histogram buckets (ms): an hour-long query lands in +Inf, the
@@ -312,27 +317,39 @@ class MetricsSink:
             "mem_hw_bytes": None,
             "mem_source": None,
         }
-        # keyed (app id, query name): thread-mode throughput streams share
-        # this process-wide sink and may run the SAME query concurrently —
-        # a name-only key would let stream B's start clobber stream A's
-        # record and A's finish retire B's (hiding a live hang)
+        # keyed (app id, query name, request id): thread-mode throughput
+        # streams share this process-wide sink and may run the SAME query
+        # concurrently — a name-only key would let stream B's start
+        # clobber stream A's record and A's finish retire B's (hiding a
+        # live hang). The request id (serve mode) extends the same
+        # guarantee to one SESSION: two tenants re-running one template
+        # concurrently share the app id, so only the per-request id keeps
+        # their in-flight records apart. Non-serve callers pass None and
+        # keep the (app, query) semantics unchanged.
         self._in_flight = {}
 
     # -- direct harness hooks -------------------------------------------
-    def query_started(self, name, app=None):
+    def query_started(self, name, app=None, request_id=None):
         """BenchReport marks the query in flight BEFORE the first attempt
         (query_span only exists at the end — too late for /statusz).
         `app` is the emitting tracer's app id, the same value the query's
-        events will carry, so event handlers find this record."""
+        events will carry, so event handlers find this record;
+        `request_id` (serve mode) disambiguates concurrent identical
+        queries on one session."""
         with self._slock:
-            self._in_flight[(app, str(name))] = {
+            self._in_flight[(app, str(name), request_id)] = {
                 "query": str(name),
                 "app": app,
+                **({"request_id": request_id} if request_id else {}),
                 "started_ts_ms": int(time.time() * 1000),
                 "_mono": time.perf_counter(),
                 "attempt": 1,
                 "ladder": [],
             }
+
+    @staticmethod
+    def _flight_key(ev):
+        return (ev.get("app"), str(ev.get("query")), ev.get("request_id"))
 
     # -- event dispatch --------------------------------------------------
     def record(self, ev: dict):
@@ -362,7 +379,7 @@ class MetricsSink:
                 "nds_query_span_mem_hw_bytes", int(ev["mem_hw_bytes"])
             )
         with self._slock:
-            self._in_flight.pop((ev.get("app"), str(ev.get("query"))), None)
+            self._in_flight.pop(self._flight_key(ev), None)
             if status == "Failed":
                 self._status["queries_failed"] += 1
             else:
@@ -459,7 +476,7 @@ class MetricsSink:
     def _h_ladder_rung(self, ev):
         self.registry.inc("nds_ladder_rung_total", rung=str(ev.get("rung")))
         with self._slock:
-            rec = self._in_flight.get((ev.get("app"), str(ev.get("query"))))
+            rec = self._in_flight.get(self._flight_key(ev))
             if rec is not None:
                 rec["attempt"] += 1
                 rec["ladder"].append(str(ev.get("rung")))
@@ -506,6 +523,61 @@ class MetricsSink:
     def _h_mem_watermark(self, ev):
         self.registry.inc("nds_mem_watermark_total")
 
+    #: distinct tenants tracked before new ones fold into "__other__":
+    #: the tenant header is client-controlled, and unbounded label values
+    #: would grow process memory + Prometheus series cardinality forever
+    #: on a long-lived service
+    MAX_TENANT_SERIES = 64
+
+    def _h_serve_request(self, ev):
+        tenant = str(ev.get("tenant"))
+        with self._slock:
+            known = self._status.get("tenants") or {}
+            if (
+                tenant not in known
+                and len(known) >= self.MAX_TENANT_SERIES
+            ):
+                tenant = "__other__"
+        status = str(ev.get("status"))
+        dur = float(ev.get("dur_ms") or 0.0)
+        self.registry.inc(
+            "nds_serve_request_total", tenant=tenant, status=status
+        )
+        self.registry.inc("nds_serve_request_ms_total", dur, tenant=tenant)
+        # unlabeled histogram on purpose: the serve_bench p99 scrape wants
+        # ONE bucket series to invert, not a per-tenant product
+        self.registry.observe("nds_serve_request_dur_ms", dur)
+        if ev.get("rows") is not None:
+            self.registry.inc(
+                "nds_serve_request_rows_total", int(ev["rows"]),
+                tenant=tenant,
+            )
+        if ev.get("bytes") is not None:
+            self.registry.inc(
+                "nds_serve_request_bytes_total", int(ev["bytes"]),
+                tenant=tenant,
+            )
+        with self._slock:
+            tenants = self._status.setdefault("tenants", {})
+            t = tenants.setdefault(tenant, {
+                "requests": 0, "completed": 0, "failed": 0, "rejected": 0,
+                "shed": 0, "draining": 0, "degraded": 0, "rows": 0,
+                "bytes": 0, "ms_total": 0.0,
+                "exec_cache_hits": 0, "exec_cache_lookups": 0,
+                "plan_cache_hits": 0, "plan_cache_lookups": 0,
+            })
+            t["requests"] += 1
+            if status in t:
+                t[status] += 1
+            if ev.get("verdict") in ("blocked", "spill", "over"):
+                t["degraded"] += 1
+            t["rows"] += int(ev.get("rows") or 0)
+            t["bytes"] += int(ev.get("bytes") or 0)
+            t["ms_total"] = round(t["ms_total"] + dur, 3)
+            for k in ("exec_cache_hits", "exec_cache_lookups",
+                      "plan_cache_hits", "plan_cache_lookups"):
+                t[k] += int(ev.get(k) or 0)
+
     def _h_heartbeat(self, ev):
         self.registry.inc("nds_heartbeat_total")
         if ev.get("rss_bytes") is not None:
@@ -519,7 +591,7 @@ class MetricsSink:
             self._status["heartbeat_ts_ms"] = ev.get("ts")
             if ev.get("rss_bytes") is not None:
                 self._status["rss_bytes"] = int(ev["rss_bytes"])
-            rec = self._in_flight.get((ev.get("app"), str(ev.get("query"))))
+            rec = self._in_flight.get(self._flight_key(ev))
             if rec is not None:
                 rec["heartbeat_elapsed_ms"] = ev.get("elapsed_ms")
 
@@ -545,6 +617,20 @@ class MetricsSink:
                 k: (dict(v) if isinstance(v, dict) else v)
                 for k, v in self._status.items()
             }
+            if "tenants" in st:
+                # deep-copy + derive per-tenant cache hit rates (the
+                # shallow copy above would alias the live tallies)
+                tenants = {}
+                for name, t in self._status["tenants"].items():
+                    t = dict(t)
+                    for fam in ("exec_cache", "plan_cache"):
+                        total = t.get(f"{fam}_lookups") or 0
+                        t[f"{fam}_hit_rate"] = (
+                            round(t[f"{fam}_hits"] / total, 4)
+                            if total else None
+                        )
+                    tenants[name] = t
+                st["tenants"] = tenants
             in_flight = []
             for rec in self._in_flight.values():
                 rec = dict(rec)
@@ -599,6 +685,7 @@ _HANDLERS = {
     "plan_budget": MetricsSink._h_plan_budget,
     "mem_watermark": MetricsSink._h_mem_watermark,
     "heartbeat": MetricsSink._h_heartbeat,
+    "serve_request": MetricsSink._h_serve_request,
 }
 
 # every handled kind must be a real schema kind (drift breaks import, not

@@ -117,6 +117,13 @@ EVENT_SCHEMA = {
     # them — the vacuum safety contract made visible
     "lake_vacuum": ("table", "files_removed", "manifests_removed",
                     "files_leased"),
+    # one serve-mode request outcome (nds_tpu/serve/service.py): status is
+    # completed | failed | rejected | shed | draining, http_status the
+    # wire answer. Optional: request_id, query, verdict (the admission
+    # echo), rows, bytes, and per-request cache tallies
+    # (exec_cache_hits/_lookups, plan_cache_hits/_lookups) that feed the
+    # per-tenant hit rates on /statusz.
+    "serve_request": ("tenant", "status", "dur_ms", "http_status"),
     # liveness beacon from the per-query memory-sampler thread
     # (obs/memwatch.py, armed by report.py while a traced query runs):
     # a hung query keeps heartbeating, so the hang is visible live on

@@ -131,9 +131,16 @@ def query_timeout(session) -> float:
 class BenchReport:
     """Records one benchmarked callable: environment, wall-clock, status."""
 
-    def __init__(self, session) -> None:
+    def __init__(self, session, tracer=None) -> None:
         self.session = session
-        self.tracer = getattr(session, "tracer", None)
+        # `tracer` override: serve mode wraps the session tracer in a
+        # per-request forwarder that labels every event with the request
+        # id + tenant; everything this report (and its sampler thread)
+        # emits must ride the same wrapper
+        self.tracer = (
+            tracer if tracer is not None
+            else getattr(session, "tracer", None)
+        )
         # live telemetry (obs/metrics.py): the sink learns query STARTS
         # directly (query_span only exists at the end — too late for
         # /statusz's in-flight view); everything else reaches it through
@@ -157,6 +164,13 @@ class BenchReport:
             "retries": 0,
         }
         self._name = None  # query/function label for emitted events
+        self._request_id = None  # serve-mode per-request id (report_on)
+        # serve-mode ladder isolation: `session.last_plan_budget` is ONE
+        # field on a session that serve shares across concurrent
+        # requests, so the ladder must consume the record CAPTURED at
+        # this statement's plan time (Session.plan_sql), not whatever a
+        # racing tenant planned last (report_on's plan_budget parameter)
+        self._plan_budget_override = None
 
     # ------------------------------------------------------------------
     # single attempt, optionally under the watchdog
@@ -242,7 +256,8 @@ class BenchReport:
         if fired:
             if self.tracer is not None:
                 self.tracer.emit(
-                    "watchdog_fire", query=self._name, budget_s=timeout
+                    "watchdog_fire", query=self._name, budget_s=timeout,
+                    **self._rid_fields(),
                 )
             return (
                 f"{_WATCHDOG_MARK}: query exceeded the {timeout:.1f}s budget "
@@ -258,8 +273,14 @@ class BenchReport:
     def _budget_prediction(self):
         """The static plan budgeter's record for the last planned
         statement when its verdict predicted memory pressure
-        (analysis/budget.py sets Session.last_plan_budget), else None."""
-        rec = getattr(self.session, "last_plan_budget", None)
+        (analysis/budget.py sets Session.last_plan_budget), else None.
+        A caller-provided record (report_on's plan_budget) wins — on a
+        shared serve session the field may belong to another request."""
+        rec = (
+            self._plan_budget_override
+            if self._plan_budget_override is not None
+            else getattr(self.session, "last_plan_budget", None)
+        )
         if not isinstance(rec, dict):
             return None
         if rec.get("verdict") not in ("blocked", "spill", "over", "reject"):
@@ -294,6 +315,12 @@ class BenchReport:
         if rec is None or rec.get("annotated"):
             return None
         return rec.get("window_rows") or None
+
+    def _rid_fields(self) -> dict:
+        """Per-request id for emitted events ({} outside serve mode)."""
+        return (
+            {"request_id": self._request_id} if self._request_id else {}
+        )
 
     def _next_rung(self, kind: str, rungs_taken, can_retry: bool):
         """The next recovery rung for a failure of `kind`, or None.
@@ -361,7 +388,11 @@ class BenchReport:
         mode = str(conf.get("engine.spill", "auto")).lower()
         if mode in ("off", "force"):
             return False
-        rec = getattr(self.session, "last_plan_budget", None)
+        rec = (
+            self._plan_budget_override
+            if self._plan_budget_override is not None
+            else getattr(self.session, "last_plan_budget", None)
+        )
         return bool(isinstance(rec, dict) and rec.get("spillable"))
 
     def _apply_rung(self, rung: str, kind: str, prior_same_rung: int):
@@ -411,7 +442,11 @@ class BenchReport:
             # out-of-core rather than re-walking the ladder per query.
             conf = getattr(session, "conf", None)
             if conf is not None:
-                rec = getattr(session, "last_plan_budget", None) or {}
+                rec = (
+                    self._plan_budget_override
+                    if self._plan_budget_override is not None
+                    else getattr(session, "last_plan_budget", None)
+                ) or {}
                 parts = (
                     int(rec.get("spill_partitions") or 0)
                     or _SPILL_RETRY_PARTS
@@ -447,7 +482,8 @@ class BenchReport:
         return None
 
     def report_on(self, fn: Callable, *args, retry_oom: bool = False,
-                  name: str = None):
+                  name: str = None, request_id: str = None,
+                  plan_budget: dict = None):
         """Run fn(*args), recording env (secrets redacted), status and time.
 
         retry_oom: allow the retrying ladder rungs (caller must guarantee
@@ -456,8 +492,21 @@ class BenchReport:
         records; they just never re-run.
 
         name: query/function label for emitted trace events (the summary
-        itself gets its name later, in write_summary)."""
+        itself gets its name later, in write_summary).
+
+        request_id: serve-mode per-request id — threaded into the sink's
+        in-flight record and every emitted event, so two tenants running
+        the SAME query name concurrently on one session cannot clobber
+        each other's /statusz state (each request retires only its own
+        record).
+
+        plan_budget: the budgeter record captured when THIS statement was
+        planned (Session.plan_sql) — the ladder consumes it instead of
+        the shared `session.last_plan_budget` field, which a concurrent
+        request may have overwritten by retry time."""
         self._name = name
+        self._request_id = request_id
+        self._plan_budget_override = plan_budget
         env_vars = {
             k: v
             for k, v in os.environ.items()
@@ -548,6 +597,7 @@ class BenchReport:
                     "mem_watermark", query=self._name, rss_bytes=int(rss),
                     watermark_bytes=watermark,
                     **({"window_rows": new} if new else {}),
+                    **self._rid_fields(),
                 )
             notify = getattr(session, "notify_failure", None)
             if notify is not None:
@@ -574,7 +624,8 @@ class BenchReport:
             # the app id keys the sink's in-flight record to THIS stream's
             # events (concurrent streams may run the same query name)
             self.sink.query_started(
-                name, app=getattr(self.tracer, "app_id", None)
+                name, app=getattr(self.tracer, "app_id", None),
+                request_id=request_id,
             )
         try:
             if sampler is not None:
@@ -600,6 +651,7 @@ class BenchReport:
                     self.tracer.emit(
                         "ladder_rung", query=name, rung=rung,
                         failure_kind=kind, **(detail or {}),
+                        **self._rid_fields(),
                     )
                 err = self._attempt(fn, args, timeout)
             if err is not None and faults.classify(err) == faults.DEVICE_OOM:
@@ -660,6 +712,7 @@ class BenchReport:
             if sampler is not None and sampler.peak_bytes is not None:
                 ev["mem_hw_bytes"] = sampler.peak_bytes
                 ev["mem_source"] = sampler.source
+            ev.update(self._rid_fields())
             self.tracer.emit("query_span", **ev)
         return self.summary
 

@@ -1,0 +1,17 @@
+"""Serve mode: the long-lived multi-tenant query service (ROADMAP item 4).
+
+`nds-tpu-submit serve` turns the batch engine into a query *service*: one
+warm Session (exec/plan/join-order/AOT caches shared across requests)
+behind `POST /query`, stream jobs, and admin verbs on the SAME process-wide
+HTTP endpoint that already serves /metrics, /statusz and /healthz
+(obs/httpserv.py). Admission control is the static plan budgeter's verdict
+per request; backpressure rides the RSS watermark; per-request isolation
+reuses the lakehouse snapshot pins + reader leases.
+"""
+
+from .service import (  # noqa: F401
+    QueryService,
+    resolve_serve_port,
+    resolve_row_cap,
+    resolve_drain_timeout,
+)

@@ -1,0 +1,701 @@
+"""Serve-mode tests: admission control, backpressure, snapshot-pinned
+requests under racing DM commits, drain, per-tenant accounting, stream
+jobs, fault-family robustness, and the closed-loop SF0.01 e2e (slow —
+ci/tier1-check runs it in the standalone serve gate).
+
+Most tests run against a synthetic in-memory (or mini-lakehouse)
+session behind the REAL HTTP listener — the same obs/httpserv.py
+process-wide endpoint production uses — so the wire contract (status
+codes, Retry-After, envelope fields) is what is asserted, not internal
+callables."""
+
+import json
+import os
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from nds_tpu import faults
+from nds_tpu.engine.session import Session
+from nds_tpu.lakehouse.table import LakehouseTable
+from nds_tpu.obs import metrics as M
+from nds_tpu.serve.service import QueryService
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    faults.reset()
+    M.reset_shared()
+    yield
+    faults.reset()
+    M.reset_shared()
+
+
+def _fact_table(rows=64):
+    return pa.table({
+        "k": pa.array(np.arange(rows) % 8, type=pa.int64()),
+        "v": pa.array(np.arange(rows), type=pa.int64()),
+    })
+
+
+def _make_service(conf=None, templates=None, lake_path=None, job_dir=None,
+                  rows=64):
+    """A service over one synthetic session behind a real ephemeral
+    listener. Returns (service, port, session)."""
+    conf = {"engine.metrics_port": 0, **(conf or {})}
+    session = Session(conf=conf)
+    if lake_path is not None:
+        session.register_lakehouse("fact", lake_path)
+    else:
+        session.register_arrow("fact", _fact_table(rows))
+    service = QueryService(
+        session, templates=templates, job_dir=job_dir
+    )
+    server = M.active_server()
+    assert server is not None, "ephemeral metrics listener failed to bind"
+    server.attach_app(service)
+    return service, server.port, session
+
+
+def _post(port, payload, tenant="default", path="/query", timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json",
+                 "X-NDS-Tenant": tenant},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        try:
+            body = json.loads(e.read().decode())
+        except ValueError:
+            body = {}
+        return e.code, body, dict(e.headers)
+
+
+def _get(port, path, timeout=30):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout
+        ) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+QUERY = "select k, count(*) c, sum(v) s from fact group by k order by k"
+
+
+# ---------------------------------------------------------------------------
+# request round trip, pagination, templates
+# ---------------------------------------------------------------------------
+
+
+def test_query_roundtrip_and_pagination():
+    service, port, _ = _make_service(conf={"engine.serve_row_cap": 3})
+    status, body, _ = _post(port, {"sql": QUERY})
+    assert status == 200
+    assert body["status"] == "completed"
+    assert body["columns"] == ["k", "c", "s"]
+    # row cap truncates: 8 groups, cap 3
+    assert body["row_count"] == 3 and body["total_rows"] == 8
+    assert body["truncated"] is True
+    assert body["verdict"] in ("direct", "unknown")
+    assert body["admitted_degraded"] is False
+    assert body["request_id"]
+    # page 2
+    status, page2, _ = _post(port, {"sql": QUERY, "offset": 3, "limit": 3})
+    assert status == 200
+    assert [r[0] for r in page2["rows"]] == [3, 4, 5]
+    # final page is not truncated
+    status, page3, _ = _post(port, {"sql": QUERY, "offset": 6, "limit": 3})
+    assert page3["row_count"] == 2 and page3["truncated"] is False
+    # limit 0 is a metadata-only probe: envelope without row payload
+    status, meta, _ = _post(port, {"sql": QUERY, "limit": 0})
+    assert meta["rows"] == [] and meta["row_count"] == 0
+    assert meta["total_rows"] == 8 and meta["truncated"] is True
+    service.close()
+
+
+def test_template_resolution_and_errors():
+    service, port, _ = _make_service(
+        templates={"q_k": "select k from fact where k = ${K} limit 1"}
+    )
+    status, body, _ = _post(
+        port, {"template": "q_k", "params": {"K": 3}}
+    )
+    assert status == 200 and body["rows"] == [[3]]
+    status, body, _ = _post(port, {"template": "nope"})
+    assert status == 404
+    status, body, _ = _post(port, {})
+    assert status == 400 and "sql" in body["error"]
+    # multi-statement scripts and session-mutating DDL are refused
+    status, body, _ = _post(
+        port, {"sql": "select 1 from fact; select 2 from fact"}
+    )
+    assert status == 400
+    status, body, _ = _post(
+        port, {"sql": "create temp view z as select k from fact"}
+    )
+    assert status == 400 and "serve mode" in body["error"]
+    service.close()
+
+
+def test_unknown_route_404_and_parse_error_400():
+    service, port, _ = _make_service()
+    status, _, _ = _post(port, {}, path="/nope")
+    assert status == 404
+    status, body, _ = _post(port, {"sql": "selec k frm fact"})
+    assert status == 400
+    service.close()
+
+
+# ---------------------------------------------------------------------------
+# admission control (the budgeter verdict contract)
+# ---------------------------------------------------------------------------
+
+
+def test_admission_reject_429_carries_modeled_bytes():
+    # budget + reject line far below what a 64Ki-row scan models: the
+    # request must be refused BEFORE dispatch with the modeled bytes in
+    # the body (the client can size its retry/shard decision from them)
+    service, port, _ = _make_service(
+        conf={
+            "engine.plan_budget_bytes": 1024,
+            "engine.plan_budget_reject_bytes": 2048,
+        },
+        rows=1 << 16,
+    )
+    status, body, _ = _post(port, {"sql": "select k + v from fact"})
+    assert status == 429
+    assert body["status"] == "rejected" and body["verdict"] == "reject"
+    assert body["peak_bytes"] > 2048
+    assert body["budget_bytes"] == 1024
+    service.close()
+
+
+def test_degraded_admit_echoes_verdict_in_envelope():
+    # over budget but under the reject line, with an out-of-core seam
+    # (ORDER BY): admitted DEGRADED — the verdict rides the envelope and
+    # the result is still correct
+    service, port, _ = _make_service(
+        conf={
+            "engine.plan_budget_bytes": 1024,
+            "engine.serve_row_cap": 1 << 17,
+        },
+        rows=1 << 12,
+    )
+    status, body, _ = _post(
+        port, {"sql": "select k, v from fact order by v desc"}
+    )
+    assert status == 200
+    assert body["verdict"] in ("spill", "over", "blocked")
+    assert body["admitted_degraded"] is True
+    assert body["rows"][0][1] == (1 << 12) - 1  # sorted desc, correct
+    service.close()
+
+
+def test_backpressure_sheds_with_retry_after():
+    # a 1-byte RSS watermark is always exceeded: every request is shed
+    # with 429 + Retry-After BEFORE planning (backpressure, not failure)
+    service, port, _ = _make_service(
+        conf={"engine.host_rss_watermark": 1}
+    )
+    status, body, headers = _post(port, {"sql": QUERY})
+    assert status == 429
+    assert body["status"] == "shed"
+    assert "watermark" in body["error"]
+    assert headers.get("Retry-After")
+    service.close()
+
+
+def test_capacity_shed_and_tenant_flood_cap():
+    service, port, _ = _make_service(
+        conf={
+            "engine.serve_workers": 2,
+            "engine.serve_tenant_cap": 1,
+            "engine.serve_admit_timeout_s": 0.05,
+        }
+    )
+    # tenant flood: one slot held by tenant A caps A, other tenants pass
+    service._enter("tenant-a")
+    try:
+        status, body, headers = _post(port, {"sql": QUERY}, tenant="tenant-a")
+        assert status == 429 and body["status"] == "shed"
+        assert "cap" in body["error"] and headers.get("Retry-After")
+        status, _, _ = _post(port, {"sql": QUERY}, tenant="tenant-b")
+        assert status == 200
+    finally:
+        service._leave("tenant-a")
+    # capacity: both slots held -> every tenant sheds after the bounded
+    # admission wait
+    service._enter("x")
+    service._enter("y")
+    try:
+        status, body, _ = _post(port, {"sql": QUERY}, tenant="tenant-c")
+        assert status == 429 and "admission slot" in body["error"]
+    finally:
+        service._leave("x")
+        service._leave("y")
+    status, _, _ = _post(port, {"sql": QUERY}, tenant="tenant-c")
+    assert status == 200
+    service.close()
+
+
+# ---------------------------------------------------------------------------
+# drain + healthz
+# ---------------------------------------------------------------------------
+
+
+def test_drain_completes_in_flight_then_refuses():
+    service, port, _ = _make_service(
+        conf={"engine.serve_drain_timeout_s": 10}
+    )
+    assert _get(port, "/healthz") == (200, "ok\n")
+    service._enter("t")  # simulated in-flight work
+    box = {}
+
+    def drain():
+        box["resp"] = _post(port, {}, path="/drain")
+
+    t = threading.Thread(target=drain, daemon=True)
+    t.start()
+    # the drain flips healthz IMMEDIATELY (LBs stop routing before the
+    # pool empties) and waits for the in-flight request
+    deadline = time.monotonic() + 5
+    while _get(port, "/healthz")[0] != 503 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    code, text = _get(port, "/healthz")
+    assert code == 503 and "draining" in text
+    assert not box  # still waiting on the in-flight slot
+    service._leave("t")
+    t.join(10)
+    status, body, _ = box["resp"]
+    assert status == 200 and body["drained"] is True
+    assert body["in_flight"] == 0
+    # admissions now refuse with 503 + Retry-After
+    status, body, headers = _post(port, {"sql": QUERY})
+    assert status == 503 and body["status"] == "draining"
+    assert headers.get("Retry-After")
+    service.close()
+
+
+def test_request_queued_before_drain_sheds_after_semaphore_wait():
+    """A request blocked in the admission wait when /drain lands must
+    SHED (503) when its slot frees, never start executing after the
+    drain reported drained=true (the rolling-restart lost-work hole)."""
+    service, port, _ = _make_service(
+        conf={
+            "engine.serve_workers": 1,
+            "engine.serve_admit_timeout_s": 20,
+            "engine.serve_drain_timeout_s": 10,
+        }
+    )
+    service._enter("holder")  # occupy the only slot
+    box = {}
+
+    def queued():
+        box["resp"] = _post(port, {"sql": QUERY}, tenant="queued")
+
+    t = threading.Thread(target=queued, daemon=True)
+    t.start()
+    time.sleep(0.3)  # the request is now blocked in the semaphore wait
+    drain_box = {}
+    dt = threading.Thread(
+        target=lambda: drain_box.update(r=_post(port, {}, path="/drain")),
+        daemon=True,
+    )
+    dt.start()
+    time.sleep(0.3)
+    service._leave("holder")  # frees the slot: queued request acquires it
+    t.join(30)
+    dt.join(30)
+    status, body, headers = box["resp"]
+    assert status == 503 and body["status"] == "draining"
+    assert headers.get("Retry-After")
+    drain_status, drain_body, _ = drain_box["r"]
+    assert drain_status == 200 and drain_body["drained"] is True
+    service.close()
+
+
+# ---------------------------------------------------------------------------
+# snapshot isolation vs racing DM commits (the PR-10 seam)
+# ---------------------------------------------------------------------------
+
+
+def _mini_lake(tmp_path, rows=64):
+    path = str(tmp_path / "fact")
+    LakehouseTable.create(path, _fact_table(rows))
+    return path
+
+
+def test_snapshot_pinned_request_vs_racing_dm_commit(tmp_path):
+    """A request planned at version N answers version-N rows even when a
+    DM commit lands between its plan and its execution. The serve:exec
+    hang opens a deterministic window; the lakehouse _COMMIT_HOOK seam
+    records the racing commit's landing time so the interleaving is
+    asserted, not assumed."""
+    from nds_tpu.lakehouse import table as lake_table
+
+    path = _mini_lake(tmp_path)
+    service, port, _ = _make_service(lake_path=path)
+    q = "select k, count(*) c, sum(v) s from fact group by k order by k"
+    status, baseline, _ = _post(port, {"sql": q})
+    assert status == 200
+
+    commits = []
+    lake_table._COMMIT_HOOK = (
+        lambda name, op, version: commits.append(
+            (name, op, version, time.monotonic())
+        )
+    )
+    faults.install("hang:serve:exec:2")
+    box = {}
+
+    def request():
+        box["t_planned"] = time.monotonic()
+        box["resp"] = _post(port, {"sql": q})
+        box["t_done"] = time.monotonic()
+
+    try:
+        t = threading.Thread(target=request, daemon=True)
+        t.start()
+        time.sleep(0.5)  # inside the 2s serve:exec hang: planned, pinned
+        writer = LakehouseTable(path)
+        writer.append(pa.table({
+            "k": pa.array([0], type=pa.int64()),
+            "v": pa.array([100_000], type=pa.int64()),
+        }))
+        t.join(60)
+    finally:
+        lake_table._COMMIT_HOOK = None
+    status, body, _ = box["resp"]
+    assert status == 200
+    # the racing commit landed while the request was in flight
+    assert commits and commits[0][1] == "append"
+    assert box["t_planned"] < commits[0][3] < box["t_done"]
+    # ... and the response is the PINNED snapshot, bit-equal to baseline
+    assert body["rows"] == baseline["rows"]
+    # a FRESH request reads the new head
+    status, after, _ = _post(port, {"sql": q})
+    assert status == 200 and after["rows"] != baseline["rows"]
+    service.close()
+
+
+def test_dml_commits_through_writer_path(tmp_path):
+    path = _mini_lake(tmp_path, rows=8)
+    service, port, session = _make_service(lake_path=path)
+    status, before, _ = _post(port, {"sql": "select count(*) c from fact"})
+    n0 = before["rows"][0][0]
+    status, body, _ = _post(
+        port,
+        {"sql": "insert into fact select k, v + 1000 from fact where v < 8"},
+        tenant="writer",
+    )
+    assert status == 200
+    assert body["status"] == "completed" and body["statement"] == "dml"
+    assert body["rows_affected"] == 8 and body["version"] == 2
+    status, after, _ = _post(port, {"sql": "select count(*) c from fact"})
+    assert after["rows"][0][0] == n0 + 8
+    service.close()
+
+
+# ---------------------------------------------------------------------------
+# fault family: the server survives what its requests do not
+# ---------------------------------------------------------------------------
+
+
+def test_serve_exec_oom_walks_ladder_and_pool_stays_healthy():
+    service, port, _ = _make_service()
+    faults.install("oom:serve:exec:1")
+    status, body, _ = _post(port, {"sql": QUERY})
+    assert status == 200 and body["status"] == "completed"
+    assert body["retries"] >= 1  # the ladder recovered the injected OOM
+    assert service.in_flight() == 0
+    status, _, _ = _post(port, {"sql": QUERY})
+    assert status == 200
+    service.close()
+
+
+def test_serve_admit_fault_sheds_not_crashes():
+    service, port, _ = _make_service()
+    faults.install("io:serve:admit:1")
+    status, body, _ = _post(port, {"sql": QUERY})
+    assert status == 429 and body["status"] == "shed"
+    assert body["failure_kind"] == faults.IO_TRANSIENT
+    status, _, _ = _post(port, {"sql": QUERY})
+    assert status == 200
+    service.close()
+
+
+def test_disconnect_mid_query_leaves_worker_pool_healthy():
+    service, port, _ = _make_service()
+    payload = json.dumps({"sql": QUERY}).encode()
+    request = (
+        b"POST /query HTTP/1.1\r\nHost: x\r\n"
+        b"Content-Type: application/json\r\n"
+        b"Content-Length: " + str(len(payload)).encode() + b"\r\n\r\n"
+        + payload
+    )
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    s.sendall(request)
+    s.close()  # hang up before the reply: the slow-client scenario
+    deadline = time.monotonic() + 30
+    while service.in_flight() > 0 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert service.in_flight() == 0
+    status, body, _ = _post(port, {"sql": QUERY})
+    assert status == 200 and body["status"] == "completed"
+    service.close()
+
+
+# ---------------------------------------------------------------------------
+# per-tenant accounting + per-request in-flight isolation
+# ---------------------------------------------------------------------------
+
+
+def test_per_tenant_stats_on_statusz_and_metrics():
+    service, port, _ = _make_service()
+    for _ in range(2):
+        _post(port, {"sql": QUERY}, tenant="alpha")
+    _post(port, {"sql": QUERY}, tenant="beta")
+    _post(port, {"sql": "selec nope"}, tenant="beta")  # 400 -> failed
+    code, text = _get(port, "/statusz")
+    st = json.loads(text)
+    tenants = st["tenants"]
+    assert tenants["alpha"]["requests"] == 2
+    assert tenants["alpha"]["completed"] == 2
+    assert tenants["beta"]["requests"] == 2
+    assert tenants["beta"]["failed"] == 1
+    # the repeated query hit the warm caches on its second run
+    assert tenants["alpha"]["exec_cache_lookups"] > 0
+    assert tenants["alpha"]["exec_cache_hit_rate"] is not None
+    code, exposition = _get(port, "/metrics")
+    assert M.validate_exposition(exposition) == []
+    assert 'nds_serve_request_total{status="completed",tenant="alpha"} 2' in (
+        exposition
+    )
+    assert "nds_serve_request_dur_ms_bucket" in exposition
+    service.close()
+
+
+def test_in_flight_records_keyed_per_request_id():
+    """The satellite fix: two concurrent identical queries (same app id,
+    same query name — one serve session, two tenants) hold SEPARATE
+    in-flight records, and each query_span retires only its own."""
+    sink = M.MetricsSink()
+    sink.query_started("query3", app="app-1", request_id="r1")
+    sink.query_started("query3", app="app-1", request_id="r2")
+    st = sink.status_snapshot()
+    assert len(st["in_flight"]) == 2
+    assert {r.get("request_id") for r in st["in_flight"]} == {"r1", "r2"}
+    sink.record({
+        "kind": "ladder_rung", "app": "app-1", "query": "query3",
+        "request_id": "r2", "rung": "recover_retry",
+    })
+    sink.record({
+        "kind": "query_span", "app": "app-1", "query": "query3",
+        "request_id": "r1", "dur_ms": 5.0, "status": "Completed",
+        "retries": 0,
+    })
+    st = sink.status_snapshot()
+    assert len(st["in_flight"]) == 1
+    rec = st["in_flight"][0]
+    assert rec["request_id"] == "r2" and rec["ladder"] == ["recover_retry"]
+    # legacy callers (no request id) keep the (app, query) semantics
+    sink.query_started("q", app="a")
+    sink.record({
+        "kind": "query_span", "app": "a", "query": "q", "dur_ms": 1.0,
+        "status": "Completed", "retries": 0,
+    })
+    assert len(sink.status_snapshot()["in_flight"]) == 1  # r2 only
+
+
+def test_concurrent_identical_queries_isolated_end_to_end():
+    service, port, _ = _make_service(
+        conf={"engine.serve_workers": 4}
+    )
+    results = []
+
+    def go(tenant):
+        results.append(_post(port, {"sql": QUERY}, tenant=tenant))
+
+    threads = [
+        threading.Thread(target=go, args=(f"t{i}",), daemon=True)
+        for i in range(3)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    assert len(results) == 3
+    assert all(status == 200 for status, _, _ in results)
+    rows = [body["rows"] for _, body, _ in results]
+    assert rows[0] == rows[1] == rows[2]
+    rids = {body["request_id"] for _, body, _ in results}
+    assert len(rids) == 3
+    # nothing left dangling on /statusz
+    st = json.loads(_get(port, "/statusz")[1])
+    assert st["in_flight"] == []
+    service.close()
+
+
+# ---------------------------------------------------------------------------
+# stream jobs (resumable, bench_state pattern)
+# ---------------------------------------------------------------------------
+
+
+def _mini_stream(tmp_path):
+    p = tmp_path / "query_9.sql"
+    p.write_text(
+        "-- start query 1 in stream 9 using template query1.tpl\n"
+        "select k, sum(v) s from fact group by k order by k;\n"
+        "-- start query 2 in stream 9 using template query2.tpl\n"
+        "select count(*) c from fact;\n"
+    )
+    return str(p)
+
+
+def test_stream_job_runs_checkpoints_and_resumes(tmp_path):
+    stream = _mini_stream(tmp_path)
+    job_dir = str(tmp_path / "jobs")
+    service, port, _ = _make_service(job_dir=job_dir)
+    status, job, _ = _post(
+        port, {"stream": stream, "job_id": "j1"}, path="/stream"
+    )
+    assert status == 202 and job["job_id"] == "j1"
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        _, job = _get_job(port, "j1")
+        if job["state"] in ("completed", "failed"):
+            break
+        time.sleep(0.1)
+    assert job["state"] == "completed"
+    assert job["total"] == 2 and job["completed"] == 2 and job["failed"] == 0
+    state_file = os.path.join(job_dir, "serve-job-j1.json")
+    assert os.path.exists(state_file)
+    # resubmission resumes from the checkpoint: everything already
+    # completed, the job finishes without re-running a single query
+    before = json.load(open(state_file))
+    status, job2, _ = _post(
+        port, {"stream": stream, "job_id": "j1"}, path="/stream"
+    )
+    assert status == 202
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        _, job2 = _get_job(port, "j1")
+        if job2["state"] in ("completed", "failed"):
+            break
+        time.sleep(0.05)
+    assert job2["state"] == "completed"
+    # per-query records survived verbatim (nothing re-ran)
+    after = json.load(open(state_file))
+    assert after["queries"] == before["queries"]
+    # a different stream under the same id is a loud 400, not a mixup
+    other = tmp_path / "other.sql"
+    other.write_text(
+        "-- start query 1 in stream 0 using template query5.tpl\n"
+        "select count(*) c from fact;\n"
+    )
+    status, body, _ = _post(
+        port, {"stream": str(other), "job_id": "j1"}, path="/stream"
+    )
+    assert status == 400 and "different stream" in body["error"]
+    status, body = _get_job(port, "missing")
+    assert status == 404
+    service.close()
+
+
+def _get_job(port, job_id):
+    code, text = _get(port, f"/jobs/{job_id}")
+    return code, json.loads(text)
+
+
+def test_reload_drops_pins_and_caches(tmp_path):
+    path = _mini_lake(tmp_path, rows=8)
+    service, port, session = _make_service(lake_path=path)
+    _post(port, {"sql": "select count(*) c from fact"})
+    assert session.catalog.entries["fact"].pinned_version is not None
+    status, body, _ = _post(port, {}, path="/reload")
+    assert status == 200 and body["reloaded"] is True
+    assert session.catalog.entries["fact"].pinned_version is None
+    status, body, _ = _post(port, {"sql": "select count(*) c from fact"})
+    assert status == 200
+    service.close()
+
+
+# ---------------------------------------------------------------------------
+# knob derivations
+# ---------------------------------------------------------------------------
+
+
+def test_serve_concurrency_derives_from_budget():
+    from nds_tpu.analysis.budget import SERVE_SLOT_BYTES, serve_concurrency
+
+    assert serve_concurrency({"engine.serve_workers": 7}) == 7
+    assert serve_concurrency(
+        {"engine.plan_budget_bytes": 4 * SERVE_SLOT_BYTES}
+    ) == 4
+    assert serve_concurrency({"engine.plan_budget_bytes": 1}) == 1
+    assert serve_concurrency(
+        {"engine.plan_budget_bytes": 1 << 50}
+    ) == 16  # clamped
+
+
+def test_event_schema_has_serve_request():
+    from nds_tpu.obs.trace import EVENT_SCHEMA
+
+    assert set(EVENT_SCHEMA["serve_request"]) == {
+        "tenant", "status", "dur_ms", "http_status",
+    }
+    for family in (
+        "nds_serve_request_total", "nds_serve_request_dur_ms",
+        "nds_serve_request_ms_total",
+    ):
+        assert M.METRIC_KINDS[family] == "serve_request"
+
+
+# ---------------------------------------------------------------------------
+# SF0.01 closed-loop e2e (slow: runs in ci/tier1-check's serve gate)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_closed_loop_sf001_e2e():
+    """The acceptance scenario: >= 4 concurrent closed-loop clients
+    (point lookups + heavy aggregates + DM writes) against the real
+    service over the SF0.01 lakehouse — zero 5xx, zero snapshot
+    violations under the racing commits, QPS x p99 reported, and the
+    server-side p99 scraped from /metrics MID-RUN."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "serve_bench", os.path.join(REPO, "tools", "serve_bench.py")
+    )
+    sb = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sb)
+    report = sb.run_bench(clients=4, smoke=True)
+    assert report["requests"] >= 20
+    assert report["completed"] > 0 and report["qps"] > 0
+    assert report["http_5xx"] == 0
+    assert report["rejected_429"] == 0
+    assert report["snapshot_violations"] == 0
+    assert report["final_snapshot_consistent"] is True
+    assert report["dm_commits"] > 0  # commits actually raced the readers
+    assert report["p99_ms"] > 0
+    # the mid-run scrape saw the live histogram and it validated
+    assert report["scraped_requests"] > 0
+    assert report["exposition_valid"] is True
+    assert report["by_class"]["heavy"]["completed"] > 0
+    assert report["by_class"]["point"]["completed"] > 0
