@@ -62,7 +62,7 @@ from typing import Optional
 
 from ..engine import expr as E
 from ..engine import plan as P
-from ..schema import TABLE_PRIMARY_KEYS
+from ..schema import TABLE_PARTITIONING, TABLE_PRIMARY_KEYS
 
 # ---------------------------------------------------------------------------
 # TPC-DS row-count model (python port of datagen/native/rowcounts.hpp — the
@@ -527,7 +527,10 @@ class NodeEstimate:
     materializes (output buffers + transient work: key words, pair gathers,
     sort scratch); `live_bytes` is what the node's result pins for its
     parent; `peak_bytes` is the modeled high-water of the whole subtree
-    (children retained while later siblings/parent work runs)."""
+    (children retained while later siblings/parent work runs). In mesh
+    mode every byte figure is PER DEVICE: a `sharded` node's buffers
+    divide by the mesh width, a replicated node's are charged in full on
+    every chip (the layout Catalog._to_device actually places)."""
 
     node: object
     desc: str
@@ -538,6 +541,7 @@ class NodeEstimate:
     live_bytes: int
     peak_bytes: int
     blocked: bool = False
+    sharded: bool = False
     children: list = field(default_factory=list)
 
 
@@ -551,6 +555,11 @@ class PlanBudget:
     budget_bytes: int
     verdict: str  # direct | blocked | spill | over | reject | unknown
     window_rows: Optional[int] = None  # set when verdict == blocked
+    #: mesh width the model divided sharded node bytes by (None = the
+    #: single-device model); the verdict is then PER DEVICE — what each
+    #: chip's working set must fit, with replicated relations charged on
+    #: every chip
+    mesh_devices: Optional[int] = None
     unknown_tables: list = field(default_factory=list)
     #: the plan carries >= 1 out-of-core seam (spillable_node) — recorded
     #: for EVERY verdict so the report ladder's spill_retry rung knows an
@@ -570,10 +579,16 @@ class PlanBudget:
                 f"{n.rows:>12}  {n.width:>6}  {n.cap:>12}  "
                 f"{_fmt_bytes(n.alloc_bytes):>10}  "
                 f"{_fmt_bytes(n.peak_bytes):>10}  "
-                f"{'[blocked] ' if n.blocked else ''}{n.desc[:72]}"
+                f"{'[blocked] ' if n.blocked else ''}"
+                f"{'[sharded] ' if n.sharded else ''}{n.desc[:72]}"
             )
         out.append(
-            f"verdict: {self.verdict}  peak={_fmt_bytes(self.peak_bytes)}"
+            (
+                f"verdict ({self.mesh_devices}-device mesh, per device): "
+                if self.mesh_devices
+                else "verdict: "
+            )
+            + f"{self.verdict}  peak={_fmt_bytes(self.peak_bytes)}"
             f" (windowed={_fmt_bytes(self.peak_blocked_bytes)})"
             f" budget={_fmt_bytes(self.budget_bytes)}"
             + (f" window_rows={self.window_rows}" if self.window_rows else "")
@@ -612,13 +627,18 @@ class PlanBudgeter:
     what the executor's _cte_cache really does to memory."""
 
     def __init__(self, catalog=None, stats: Optional[CatalogStats] = None,
-                 budget_bytes: Optional[int] = None, windowed: bool = False):
+                 budget_bytes: Optional[int] = None, windowed: bool = False,
+                 mesh_devices: Optional[int] = None):
         from .verifier import PlanVerifier, _count_plan_refs
 
         self.stats = stats or CatalogStats(catalog)
         self.budget_bytes = (
             budget_bytes if budget_bytes is not None else DEFAULT_BUDGET_BYTES
         )
+        #: mesh width: sharded node bytes divide by this (per-device
+        #: verdict), replicated relations stay charged in full per device.
+        #: 1 = the single-device model, byte-identical to pre-mesh output.
+        self.n_dev = max(int(mesh_devices or 1), 1)
         #: windowed=True models blocked-union aggregates on the windowed
         #: executor path (branches materialized, concat/join/aggregate per
         #: bounded window) instead of the direct full-concat path
@@ -658,11 +678,21 @@ class PlanBudgeter:
     def _width(self, node) -> int:
         return schema_row_bytes(self._schema(node))
 
+    def _div(self, nbytes, sharded: bool) -> int:
+        """Per-device share of a byte figure: sharded buffers split over
+        the mesh width, everything else is charged in full on each chip
+        (the replicated-dim placement). Identity on a 1-wide mesh."""
+        if sharded and self.n_dev > 1:
+            return int(nbytes) // self.n_dev
+        return int(nbytes)
+
     def _finish(self, node, rows, width, alloc, children,
-                live=None, blocked=False) -> NodeEstimate:
+                live=None, blocked=False, sharded=False) -> NodeEstimate:
         rows = max(int(rows), 0)
         cap = bucket_cap(max(rows, 1))
-        live_b = live if live is not None else cap * width
+        live_b = (
+            live if live is not None else self._div(cap * width, sharded)
+        )
         # executor retention model: children run left-to-right, each
         # earlier child's result stays live while later siblings execute,
         # and all children stay live while this node materializes
@@ -682,6 +712,7 @@ class PlanBudgeter:
             live_bytes=int(live_b),
             peak_bytes=int(peak),
             blocked=blocked,
+            sharded=bool(sharded),
         )
         self._post.append(est)
         return est
@@ -701,7 +732,20 @@ class PlanBudgeter:
         self._memo[key] = est
         return est
 
-    # -- per-node rules (mirror exec.py materialization) ----------------
+    # -- per-node rules (mirror exec.py materialization; sharded-ness
+    # mirrors the verifier's PartitionSpec propagation so the byte model
+    # and the sharding rules can never disagree about layout) ------------
+    def _scan_sharded(self, table: str, cap: int) -> bool:
+        """True when Catalog._to_device would row-shard this base table
+        over the mesh: a registered fact (TABLE_PARTITIONING — the same
+        registry table_partition_spec derives from) whose capacity bucket
+        divides the mesh width (else the loud replication fallback)."""
+        return (
+            self.n_dev > 1
+            and table in TABLE_PARTITIONING
+            and cap % self.n_dev == 0
+        )
+
     def _est_scan(self, node: P.Scan) -> NodeEstimate:
         rows = self.stats.table_rows(node.table)
         if rows is None:
@@ -709,7 +753,11 @@ class PlanBudgeter:
             rows = 0
         width = self._width(node)
         cap = bucket_cap(max(rows, 1))
-        return self._finish(node, rows, width, cap * width, [])
+        sharded = self._scan_sharded(node.table, cap)
+        return self._finish(
+            node, rows, width, self._div(cap * width, sharded), [],
+            sharded=sharded,
+        )
 
     def _est_materializedscan(self, node: P.MaterializedScan) -> NodeEstimate:
         rows = 1
@@ -730,7 +778,9 @@ class PlanBudgeter:
             if not isinstance(e, E.Col)
         )
         return self._finish(
-            node, child.rows, width, child.cap * computed, [child]
+            node, child.rows, width,
+            self._div(child.cap * computed, child.sharded), [child],
+            sharded=child.sharded,
         )
 
     def _est_filter(self, node: P.Filter) -> NodeEstimate:
@@ -739,8 +789,10 @@ class PlanBudgeter:
         # deferred compaction: the live mask is the only new buffer; data
         # buffers are shared with the child (capacity stays the child's)
         return self._finish(
-            node, rows, child.width, child.cap, [child],
-            live=child.cap * child.width,
+            node, rows, child.width,
+            self._div(child.cap, child.sharded), [child],
+            live=self._div(child.cap * child.width, child.sharded),
+            sharded=child.sharded,
         )
 
     def _est_pipeline(self, node: P.Pipeline) -> NodeEstimate:
@@ -755,11 +807,14 @@ class PlanBudgeter:
             # to the boundary), so the key/sort-word working set scales
             # with the child's capacity, not the post-filter estimate
             return self._agg_estimate(node, node.agg, [child], rows,
-                                      child.cap)
+                                      child.cap, in_sharded=child.sharded)
         width = self._width(node)
         # the fused body materializes the full output column set at the
         # input capacity in one dispatch (masks deferred to the boundary)
-        return self._finish(node, rows, width, child.cap * width, [child])
+        return self._finish(
+            node, rows, width, self._div(child.cap * width, child.sharded),
+            [child], sharded=child.sharded,
+        )
 
     def _keys_unique(self, side, keys) -> bool:
         """True when `keys` cover a declared primary key of the side's
@@ -793,15 +848,19 @@ class PlanBudgeter:
             rows = max(left.rows, right.rows)
         width = self._width(node)
         cap = bucket_cap(max(rows, 1))
+        sharded = left.sharded or right.sharded
         # key words (8B per side) + compaction of both inputs + the pair
-        # table gathered at the output width
+        # table gathered at the output width — per side's own layout: a
+        # sharded fact's words/compaction split over the mesh (exchange /
+        # local probe), a replicated dim pays full on every chip
         alloc = (
-            8 * (left.cap + right.cap)
-            + left.cap * left.width
-            + right.cap * right.width
-            + cap * width
+            self._div(8 * left.cap + left.cap * left.width, left.sharded)
+            + self._div(8 * right.cap + right.cap * right.width,
+                        right.sharded)
+            + self._div(cap * width, sharded)
         )
-        return self._finish(node, rows, width, alloc, [left, right])
+        return self._finish(node, rows, width, alloc, [left, right],
+                            sharded=sharded)
 
     def _est_multijoin(self, node: P.MultiJoin) -> NodeEstimate:
         rels = [self._est(r) for r in node.relations]
@@ -831,8 +890,11 @@ class PlanBudgeter:
                 non_unique.append(rels[i].rows)
         rows = max(non_unique or [r.rows for r in rels] or [1])
         cap = bucket_cap(max(rows, 1))
-        alloc = 2 * cap * width + sum(8 * r.cap for r in rels)
-        return self._finish(node, rows, width, alloc, rels)
+        sharded = any(r.sharded for r in rels)
+        alloc = self._div(2 * cap * width, sharded) + sum(
+            self._div(8 * r.cap, r.sharded) for r in rels
+        )
+        return self._finish(node, rows, width, alloc, rels, sharded=sharded)
 
     def _agg_groups(self, agg, in_rows: int) -> int:
         """Group-count bound. Each key column's distinct values are bounded
@@ -861,16 +923,18 @@ class PlanBudgeter:
         return max(min(prod, in_rows), 1)
 
     def _agg_estimate(self, node, agg, children, in_rows, in_cap,
-                      blocked=False) -> NodeEstimate:
+                      blocked=False, in_sharded=False) -> NodeEstimate:
         sch = self._schema(node)
         width = schema_row_bytes(sch)
         groups = self._agg_groups(agg, in_rows)
         levels = min(len(agg.grouping_sets), 3) if agg.grouping_sets else 1
         rows = groups * (2 if agg.grouping_sets else 1)
         cap = bucket_cap(max(rows, 1))
-        # segment-reduce path: 2 x 8B key/sort words over the input + the
-        # group output (x cascade levels' incremental concat)
-        alloc = 16 * in_cap + levels * cap * width
+        # segment-reduce path: 2 x 8B key/sort words over the input (per
+        # shard under a mesh — the scatter-add lowers to per-chip partials)
+        # + the group output (x cascade levels' incremental concat), which
+        # MERGES replicated (psum) and is charged in full per device
+        alloc = self._div(16 * in_cap, in_sharded) + levels * cap * width
         return self._finish(node, rows, width, alloc, children,
                             blocked=blocked)
 
@@ -882,7 +946,7 @@ class PlanBudgeter:
         child = self._est(node.child)
         return self._agg_estimate(
             node, node, [child], child.rows, child.cap,
-            blocked=bool(node.blocked_union),
+            blocked=bool(node.blocked_union), in_sharded=child.sharded,
         )
 
     def _est_blocked_agg(self, node: P.Aggregate, shape) -> NodeEstimate:
@@ -922,23 +986,35 @@ class PlanBudgeter:
     def _est_window(self, node: P.Window) -> NodeEstimate:
         child = self._est(node.child)
         width = self._width(node)
+        # NOT divided under a mesh: the generic window sort all-gathers,
+        # so each device pays the full working set (the conservative
+        # bound; a future dist-window rewrite can claim the division)
         alloc = 16 * child.cap + 8 * child.cap * max(len(node.fns), 1)
-        return self._finish(node, child.rows, width, alloc, [child])
+        return self._finish(node, child.rows, width, alloc, [child],
+                            sharded=child.sharded)
 
     def _est_sort(self, node: P.Sort) -> NodeEstimate:
         child = self._est(node.child)
         width = child.width
-        alloc = 16 * child.cap + child.cap * width
-        return self._finish(node, child.rows, width, alloc, [child])
+        # sharded input: the samplesort exchange range-partitions, so no
+        # device ever materializes the whole table (exec._try_dist_sort)
+        alloc = self._div(16 * child.cap + child.cap * width, child.sharded)
+        return self._finish(node, child.rows, width, alloc, [child],
+                            sharded=child.sharded)
 
     def _est_limit(self, node: P.Limit) -> NodeEstimate:
         child = self._est(node.child)
         rows = min(child.rows, max(int(node.n), 0))
-        return self._finish(node, rows, child.width, 0, [child])
+        return self._finish(node, rows, child.width, 0, [child],
+                            sharded=child.sharded)
 
     def _est_distinct(self, node: P.Distinct) -> NodeEstimate:
         child = self._est(node.child)
-        alloc = 16 * child.cap + child.cap * child.width
+        # input-side dedup work splits over shards; the deduped output
+        # merges replicated (like Aggregate), so live bytes stay full
+        alloc = self._div(
+            16 * child.cap + child.cap * child.width, child.sharded
+        )
         return self._finish(node, child.rows, child.width, alloc, [child])
 
     def _est_setop(self, node: P.SetOp) -> NodeEstimate:
@@ -950,11 +1026,17 @@ class PlanBudgeter:
             rows = left.rows
         cap = bucket_cap(max(rows, 1))
         # the concat materializes both sides into one capacity bucket;
-        # distinct set ops add a sort-words pass
-        alloc = cap * width + (16 * cap if node.op != "union_all" else 0)
+        # distinct set ops add a sort-words pass. Sharded only when BOTH
+        # sides are (the verifier's sharding-axis rule forbids mixing)
+        sharded = left.sharded and right.sharded
+        alloc = self._div(
+            cap * width + (16 * cap if node.op != "union_all" else 0),
+            sharded,
+        )
         if node.op == "union":
             rows = max(rows // 2, 1)
-        return self._finish(node, rows, width, alloc, [left, right])
+        return self._finish(node, rows, width, alloc, [left, right],
+                            sharded=sharded)
 
 
 # ---------------------------------------------------------------------------
@@ -968,6 +1050,7 @@ def analyze_plan(
     scale_factor: Optional[float] = None,
     budget_bytes: Optional[int] = None,
     reject_bytes: Optional[int] = None,
+    mesh_devices: Optional[int] = None,
 ) -> PlanBudget:
     """Analyze one bound + rewritten plan against a catalog (or the TPC-DS
     scale model when `scale_factor` is given): a direct-path pass, a
@@ -981,9 +1064,15 @@ def analyze_plan(
                the reject line: admitted, prediction armed for the ladder
       reject   beyond the reject line even windowed — admission refuses it
       unknown  some base-table cardinality unavailable; no enforcement
-    """
+
+    With `mesh_devices` > 1 the model is PER DEVICE: sharded node bytes
+    divide by the mesh width, replicated relations are charged on every
+    chip, and the verdict answers "does each chip's share fit its HBM
+    budget" — the admission question a mesh session (and serve mode on
+    one) actually has."""
     stats = CatalogStats(catalog, scale_factor)
-    direct = PlanBudgeter(catalog, stats, budget_bytes, windowed=False)
+    direct = PlanBudgeter(catalog, stats, budget_bytes, windowed=False,
+                          mesh_devices=mesh_devices)
     peak = direct.run(plan)
     budget = direct.budget_bytes
     reject_line = (
@@ -993,7 +1082,8 @@ def analyze_plan(
     peak_blocked = peak
     window_rows = None
     if has_blocked:
-        win = PlanBudgeter(catalog, stats, budget_bytes, windowed=True)
+        win = PlanBudgeter(catalog, stats, budget_bytes, windowed=True,
+                           mesh_devices=mesh_devices)
         peak_blocked = min(win.run(plan), peak)
         if win.blocked_windows:
             window_rows = min(win.blocked_windows)
@@ -1037,6 +1127,9 @@ def analyze_plan(
         budget_bytes=budget,
         verdict=verdict,
         window_rows=window_rows,
+        mesh_devices=(
+            int(mesh_devices) if mesh_devices and mesh_devices > 1 else None
+        ),
         unknown_tables=list(direct.unknown_tables),
         spillable=spillable,
         spill_partitions=spill_partitions,
@@ -1057,8 +1150,43 @@ def emit_budget_event(tracer, pb: PlanBudget) -> None:
         peak_blocked_bytes=pb.peak_blocked_bytes,
         window_rows=pb.window_rows,
         spill_partitions=pb.spill_partitions,
+        mesh_devices=pb.mesh_devices,
         nodes=len(pb.nodes),
     )
+
+
+def session_mesh_devices(session) -> Optional[int]:
+    """The mesh width a session's plans execute over: the live
+    jax.sharding.Mesh when the session carries one, else the declared
+    `engine.mesh_devices` conf — but the conf fallback applies ONLY to
+    schema-only sessions (explain/corpus: catalog entries carry a schema
+    and no data, so nothing will ever execute). A session with real data
+    but no mesh executes single-device, and a stray conf key must not
+    buy it per-device admission verdicts for plans that will run on one
+    chip (q14@SF10 modeled 'direct'/8-wide would admit straight into the
+    device OOM the budgeter exists to prevent). None/1 = the
+    single-device model."""
+    mesh = getattr(session, "mesh", None)
+    if mesh is not None:
+        try:
+            n = int(mesh.devices.size)
+        except AttributeError:
+            n = int(getattr(mesh, "size", 0) or 0)
+        if n > 1:
+            return n
+        return None  # a real 1-wide mesh: single-device, conf ignored
+    entries = getattr(getattr(session, "catalog", None), "entries", {})
+    if any(
+        getattr(e, "arrow", None) is not None
+        or getattr(e, "path", None) is not None
+        for e in entries.values()
+    ):
+        return None  # live data, no mesh: plans execute single-device
+    try:
+        n = int(session.conf.get("engine.mesh_devices") or 0)
+    except (TypeError, ValueError):
+        n = 0
+    return n if n > 1 else None
 
 
 def budget_plan(plan: P.PlanNode, session) -> Optional[PlanBudget]:
@@ -1089,6 +1217,7 @@ def budget_plan(plan: P.PlanNode, session) -> Optional[PlanBudget]:
             scale_factor=float(sf) if sf else None,
             budget_bytes=resolve_budget_bytes(session.conf),
             reject_bytes=resolve_reject_bytes(session.conf),
+            mesh_devices=session_mesh_devices(session),
         )
     except Exception as exc:
         if os.environ.get("NDS_PLAN_BUDGET_STRICT"):
@@ -1122,6 +1251,9 @@ def budget_plan(plan: P.PlanNode, session) -> Optional[PlanBudget]:
         "peak_bytes": pb.peak_bytes,
         "budget_bytes": pb.budget_bytes,
         "window_rows": pb.window_rows,
+        # mesh width the per-device model divided sharded bytes by (None
+        # for the single-device model) — serve-mode admission echoes it
+        "mesh_devices": pb.mesh_devices,
         "annotated": annotate and not explicit,
         # spill_retry arming: recorded for EVERY verdict — an unpredicted
         # device OOM on a direct/over-verdict plan with an out-of-core

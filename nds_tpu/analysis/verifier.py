@@ -309,6 +309,30 @@ class PlanVerifier:
                             f"{REPLICATED_BYTES_CAP >> 30} GiB defeats "
                             f"sharding (partition it or shrink it)",
                         )
+                # the catalog RECORDED a replication fallback for this
+                # fact table (Catalog._to_device couldn't row-shard it):
+                # every later plan scanning it is flagged, so the one-line
+                # mesh_fallback event can never stay the only evidence of
+                # a fact-scale table copied to every chip
+                e = (
+                    getattr(self.catalog, "entries", {}).get(n.table)
+                    if self.catalog is not None
+                    else None
+                )
+                if s and e is not None and getattr(e, "mesh_fallback", False):
+                    width = self._scan_width(n)
+                    sized = (
+                        f" (~{(rows or 0) * width >> 20} MiB per device)"
+                        if rows is not None
+                        else ""
+                    )
+                    self._viol(
+                        "replicated-dim", n,
+                        f"fact table {n.table!r} was silently replicated "
+                        f"by the catalog mesh fallback{sized}; a "
+                        f"row-shardable layout (pow2 mesh, cap divisible "
+                        f"by the device count) is required to scale out",
+                    )
                 return s
             if isinstance(n, (P.Aggregate, P.Distinct)):
                 spec_of(n.child)

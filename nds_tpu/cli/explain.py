@@ -81,6 +81,13 @@ def main(argv=None) -> int:
         help="print the per-node estimate table + verdict",
     )
     ap.add_argument(
+        "--mesh", type=int, default=None, metavar="N",
+        help="model execution over an N-device mesh: sharded node bytes "
+        "divide by N, replicated relations are charged per device, and "
+        "the verdict is per-device (defaults to engine.mesh_devices "
+        "when configured; schema-only — no backend is built)",
+    )
+    ap.add_argument(
         "--scale", type=float, default=1.0,
         help="scale factor for schema-only cardinalities (default 1.0)",
     )
@@ -116,6 +123,9 @@ def main(argv=None) -> int:
             print(res.explain(), end="")
             if not args.budget:
                 continue
+            mesh_devs = args.mesh
+            if mesh_devs is None:
+                mesh_devs = B.session_mesh_devices(sess)
             pb = B.analyze_plan(
                 res.plan,
                 sess.catalog,
@@ -123,6 +133,7 @@ def main(argv=None) -> int:
                 budget_bytes=(
                     int(args.budget_bytes) if args.budget_bytes else None
                 ),
+                mesh_devices=mesh_devs,
             )
             print(pb.table(limit=args.top))
             B.emit_budget_event(sess.tracer, pb)

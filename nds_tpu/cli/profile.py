@@ -111,6 +111,16 @@ def _render_profile(prof, top: int, per_query: bool):
           f"watchdog fires {t['watchdog_fires']}; faults injected "
           f"{t['faults_injected']}; blocked-union windows "
           f"{t['blocked_union_windows']}")
+    # mesh-execution evidence (exchange/mesh_fallback events); .get()
+    # because compacted artifacts from pre-mesh runs lack the keys
+    if t.get("exchange_ops") or t.get("mesh_fallbacks"):
+        print(f"== exchange: {t.get('exchange_ops', 0)} collective "
+              f"exchange(s) moved {_fmt_bytes(t.get('exchange_bytes', 0))} "
+              f"over the interconnect; {t.get('exchange_retries', 0)} "
+              f"overflow retries; worst skew "
+              f"{t.get('exchange_max_skew', 0.0):.2f}x"
+              + (f"; {t['mesh_fallbacks']} replication fallback(s)"
+                 if t.get("mesh_fallbacks") else ""))
     # out-of-core evidence (spill events); .get() because compacted
     # artifacts from pre-spill runs lack the keys
     if t.get("spill_ops"):
@@ -234,7 +244,61 @@ def _compare_sqlite_shared(old_path, new_path):
     return out
 
 
+def _load_multichip(path):
+    """A MULTICHIP round artifact: the driver wrapper ({n_devices, rc, ok,
+    tail}) or the mesh gate's metrics block (tools/mesh_stream_check.py).
+    None when unreadable — comparison is fail-soft by contract."""
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return obj if isinstance(obj, dict) else None
+
+
+def _compare_multichip(old_path, new_path):
+    """MULTICHIP round comparison (ISSUE 13): the SF0.01 mesh-vs-oracle
+    gate's artifact against the newest stored MULTICHIP_r*.json — the
+    same fail-soft shape as the sqlite_shared headline. Old rounds
+    (r01–r05 are driver wrappers with only {ok, tail}) predate the
+    metrics block, so old_ratio starts null. Regression: the mesh run
+    stopped being ok, or the mesh-vs-oracle wall ratio worsened > 25%."""
+    old = _load_multichip(old_path) or {}
+    new = _load_multichip(new_path)
+    out = []
+    if new is None:
+        out.append({
+            "level": "bench", "change": "status_change",
+            "query": "multichip",
+            "detail": f"unreadable multichip artifact {new_path}",
+        })
+        return out
+    rec = {
+        "level": "bench", "query": "multichip",
+        "old_ratio": old.get("mesh_vs_oracle_wall_ratio"),
+        "new_ratio": new.get("mesh_vs_oracle_wall_ratio"),
+        "queries": new.get("matched"),
+        "old_ok": old.get("ok"), "new_ok": new.get("ok"),
+        "change": "headline",
+    }
+    r_old, r_new = rec["old_ratio"], rec["new_ratio"]
+    if old.get("ok") and not new.get("ok"):
+        rec["change"] = "regression"
+    elif r_old is not None and r_new is not None and r_new > r_old * 1.25:
+        rec["change"] = "regression"
+    out.append(rec)
+    return out
+
+
 def _print_bench_rec(r):
+    if r.get("query") == "multichip":
+        old_s = "-" if r.get("old_ratio") is None else f"{r['old_ratio']:.3f}"
+        new_s = "-" if r.get("new_ratio") is None else f"{r['new_ratio']:.3f}"
+        flag = "  ** REGRESSED" if r["change"] == "regression" else ""
+        ok = "ok" if r.get("new_ok") else "NOT OK"
+        print(f"== multichip mesh-vs-oracle wall ratio: {old_s} -> {new_s} "
+              f"over {r.get('queries')} matched queries ({ok}){flag}")
+        return
     old_s = "-" if r["old_ratio"] is None else f"{r['old_ratio']:.3f}"
     flag = "  ** REGRESSED" if r["change"] == "regression" else ""
     above = (
@@ -372,7 +436,20 @@ def main(argv=None):
                 old_prof, new_prof, ratio=args.ratio, min_ms=args.min_ms
             )
         if args.bench:
-            regs.extend(_compare_sqlite_shared(*args.bench))
+            # artifact-type detection: a MULTICHIP round carries n_devices
+            # (driver wrapper or mesh-gate metrics block); everything else
+            # is a bench OUT line with the sqlite_shared headline. EITHER
+            # side identifying as multichip routes here — an unreadable
+            # NEW artifact (gate died before writing) must land on
+            # _compare_multichip's fail-soft status_change record, not on
+            # the sqlite loader's bare open()
+            objs = [_load_multichip(p) for p in args.bench]
+            if any(
+                isinstance(o, dict) and "n_devices" in o for o in objs
+            ):
+                regs.extend(_compare_multichip(*args.bench))
+            else:
+                regs.extend(_compare_sqlite_shared(*args.bench))
         if args.as_json:
             print(json.dumps({"regressions": regs}, indent=2))
         else:

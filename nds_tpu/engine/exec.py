@@ -212,6 +212,11 @@ class Executor:
         # executions, accumulated across ops (tests/bench evidence)
         self.last_spill = None
         self._fault_checked = False  # exec-root injection fires once
+        # inside a spilled-join partition loop the mesh exchange path is
+        # disabled: the partitions exist because an exchange (or the
+        # budgeter) already decided the whole join can't fit — re-entering
+        # the exchange per partition pair could recurse under skew
+        self._exchange_disabled = False
         if tracer is None:
             tracer = getattr(
                 getattr(catalog, "session", None), "tracer", None
@@ -680,7 +685,42 @@ class Executor:
     # ORDER BY over a mesh-sharded table: range-partitioned samplesort +
     # global rank compaction over ICI (nds_tpu/parallel/dist.py:sample_sort)
     # instead of the all-gathering lexsort the generic path would lower to.
-    _DIST_SORT_MIN_ROWS = 1 << 18
+    # Default threshold derives PER DEVICE (n_dev x this): the old flat
+    # 256Ki floor was a dryrun-era cap that kept the exchange paths cold at
+    # every realistic bench scale — SF0.01 fact scans must already route
+    # through the collective machinery so the mesh gate exercises it.
+    _DIST_SORT_MIN_ROWS_PER_DEV = 2048
+
+    def _mesh_min_rows(self, session, conf_key, per_dev, n_dev) -> int:
+        """Row threshold for a mesh collective path: explicit conf wins,
+        else n_dev x per-device default (scale-out keeps the single-device
+        crossover point instead of inheriting a flat pod-sized floor)."""
+        v = session.conf.get(conf_key)
+        if v is not None:
+            try:
+                return int(v)
+            except (TypeError, ValueError):
+                pass
+        return int(n_dev) * int(per_dev)
+
+    def _emit_exchange(self, op, n_dev, bytes_moved, counts, retries):
+        """One `exchange` trace event per executed collective exchange:
+        bytes moved over the interconnect (padded-capacity measure, both
+        all_to_all passes), partition (device) count, the received-row
+        skew ratio (max device / mean; 1.0 = perfectly balanced), and how
+        many capacity-overflow retries the step burned."""
+        if self.tracer is None:
+            return
+        c = np.asarray(counts, dtype=np.float64)
+        total = float(c.sum())
+        skew = 1.0
+        if total > 0 and c.size:
+            skew = float(c.max() / (total / c.size))
+        self.tracer.emit(
+            "exchange", op=op, partitions=int(n_dev),
+            bytes_moved=int(bytes_moved), skew=round(skew, 3),
+            retries=int(retries),
+        )
 
     def _try_dist_sort(self, child: Table, keys):
         if not keys:
@@ -691,12 +731,13 @@ class Executor:
         mesh = getattr(session, "mesh", None)
         if mesh is None:
             return None
-        min_rows = int(
-            session.conf.get("engine.dist_sort_min_rows", self._DIST_SORT_MIN_ROWS)
+        n_dev = mesh.devices.size
+        min_rows = self._mesh_min_rows(
+            session, "engine.dist_sort_min_rows",
+            self._DIST_SORT_MIN_ROWS_PER_DEV, n_dev,
         )
         if child.nrows < min_rows:
             return None
-        n_dev = mesh.devices.size
         cap = child.cap
         if cap % n_dev or cap // n_dev == 0:
             return None
@@ -736,6 +777,7 @@ class Executor:
         live = child.row_mask()
         local_rows = cap // n_dev
         cap_route = bucket_cap(max(1, 2 * local_rows // n_dev))
+        retries = 0
         while True:
             fn = get_sample_sort(mesh, len(tkeys), len(payload), cap_route)
             out = fn(route, live, *tkeys, *payload)
@@ -744,13 +786,20 @@ class Executor:
                 break
             if cap_route >= local_rows:  # can't overflow at this cap; bug guard
                 return None
+            retries += 1
             self.on_task_failure(
                 f"task retry: distributed sort bucket overflow "
                 f"({overflow} rows); doubling route capacity"
             )
             cap_route = min(cap_route * 2, local_rows)
+        per_row = sum(int(a.dtype.itemsize) for a in tkeys + payload) + 1
+        self._emit_exchange(
+            "sort", n_dev,
+            per_row * (n_dev * n_dev * cap_route + n_dev * cap),
+            out[-2], retries,
+        )
         cols_out = out[1:1 + len(child.columns)]
-        valids_out = list(out[1 + len(child.columns):-1])
+        valids_out = list(out[1 + len(child.columns):-2])
         cols = {}
         vi = 0
         for i, (name, c) in enumerate(child.columns.items()):
@@ -1018,7 +1067,8 @@ class Executor:
         if fast is not None:
             return fast
         fast = self._try_exchange_join(
-            left, right, kind, lk, lv, rk, rv, llive, rlive, residual
+            left, right, kind, left_keys, right_keys,
+            lk, lv, rk, rv, llive, rlive, residual
         )
         if fast is not None:
             return fast
@@ -1314,28 +1364,44 @@ class Executor:
         )
 
     # -- distributed fact-fact hash join ---------------------------------
-    # When both inner-join inputs are large under a mesh, neither fits the
+    # When both join inputs are large under a mesh, neither fits the
     # dense/replicated star path; hash-partition both sides over ICI with
     # all_to_all and join each partition locally (the reference's Spark
     # shuffle join, rebuilt on XLA collectives: nds_tpu/parallel/dist.py).
     # Capacity overflows retry with doubled caps and emit a task-failure
-    # event, so the harness reports CompletedWithTaskFailures.
-    _EXCHANGE_MIN_ROWS = 1 << 16
+    # event, so the harness reports CompletedWithTaskFailures; an overflow
+    # that persists past the retries (single-key-scale skew a hash
+    # partitioning cannot split) tiers through the PR-9 host spill pool
+    # instead of falling back to the all-gathering sort join. Default
+    # threshold derives PER DEVICE — see _DIST_SORT_MIN_ROWS_PER_DEV.
+    _EXCHANGE_MIN_ROWS_PER_DEV = 256
+    _EXCHANGE_MAX_ATTEMPTS = 5
 
     def _try_exchange_join(
-        self, left, right, kind, lk, lv, rk, rv, llive, rlive, residual
+        self, left, right, kind, left_keys, right_keys,
+        lk, lv, rk, rv, llive, rlive, residual,
     ):
         mesh = getattr(self.catalog, "session", None)
         mesh = getattr(mesh, "mesh", None)
-        if mesh is None or kind != "inner":
+        if mesh is None or kind not in ("inner", "left"):
+            return None
+        if kind == "left" and residual is not None:
+            # a residual LEFT needs the direct path's match-after-filter
+            # recount; decline rather than re-derive it over the exchange
+            return None
+        if getattr(self, "_exchange_disabled", False):
+            # inside a spilled-join partition loop: those partitions exist
+            # because an exchange already overflowed — re-entering the
+            # exchange per partition could recurse under single-key skew
             return None
         session = self.catalog.session
-        min_rows = int(
-            session.conf.get("engine.exchange_min_rows", self._EXCHANGE_MIN_ROWS)
+        n_dev = mesh.devices.size
+        min_rows = self._mesh_min_rows(
+            session, "engine.exchange_min_rows",
+            self._EXCHANGE_MIN_ROWS_PER_DEV, n_dev,
         )
         if left.nrows < min_rows or right.nrows < min_rows:
             return None
-        n_dev = mesh.devices.size
         if left.cap % n_dev or right.cap % n_dev:
             return None
         # mesh-only cold path (see _try_dist_sort)
@@ -1373,18 +1439,23 @@ class Executor:
         pair_cap = bucket_cap(
             max(1, 2 * max(left.nrows, right.nrows) // n_dev)
         )
-        for _attempt in range(5):
+        retries = 0
+        rest = None
+        used_l, used_r = cap_l, cap_r  # caps the LAST attempt shipped with
+        for _attempt in range(self._EXCHANGE_MAX_ATTEMPTS):
             fn = get_exchange_hash_join(
-                mesh, len(lk), n_lc, n_rc, cap_l, cap_r, pair_cap
+                mesh, len(lk), n_lc, n_rc, cap_l, cap_r, pair_cap, kind
             )
             out = fn(
                 (lh, lnn, *lk, *l_ship),
                 (rh, rnn, *rk, *r_ship),
             )
             ok, rest = out[0], out[1:]
+            used_l, used_r = cap_l, cap_r
             overflow = int(rest[-1])
             if overflow == 0:
                 break
+            retries += 1
             self.on_task_failure(
                 f"task retry: exchange join capacity overflow "
                 f"({overflow} rows); doubling caps"
@@ -1393,7 +1464,41 @@ class Executor:
             cap_r *= 2
             pair_cap *= 2
         else:
-            return None  # persistent overflow: fall back to the sort join
+            # persistent overflow: the hot destination cannot fit a fixed
+            # per-device capacity (a single key owning most of the rows
+            # never splits under hash partitioning). Planned degradation
+            # composes with scale-out: join through the host spill pool —
+            # partition outputs stage host-side, only one partition pair
+            # is ever live in HBM — instead of aborting the stream or
+            # all-gathering through the generic sort join.
+            if rest is not None:
+                self._emit_exchange(
+                    "join", n_dev,
+                    self._exchange_bytes(n_dev, used_l, used_r,
+                                         lh, lk, l_ship, rh, rk, r_ship),
+                    rest[-2], retries,
+                )
+            if str(session.conf.get("engine.spill", "auto")).lower() == "off":
+                return None  # out-of-core disabled: legacy sort-join fallback
+            self.on_task_failure(
+                "exchange join capacity overflow persists after "
+                f"{retries} retries; tiering through the host spill pool"
+            )
+            parts = max(self._SPILL_FORCE_PARTS, n_dev)
+            self._exchange_disabled = True
+            try:
+                return self._spilled_join(
+                    left, right, kind, left_keys, right_keys, residual,
+                    lk, lv, llive, rk, rv, rlive, parts,
+                )
+            finally:
+                self._exchange_disabled = False
+        self._emit_exchange(
+            "join", n_dev,
+            self._exchange_bytes(n_dev, used_l, used_r,
+                                 lh, lk, l_ship, rh, rk, r_ship),
+            rest[-2], retries,
+        )
         l_out = rest[:n_lc]
         r_out = rest[n_lc:n_lc + n_rc]
         nl = len(left.columns)
@@ -1428,7 +1533,64 @@ class Executor:
             result = self._compact(
                 result, self._predicate_mask(result, residual)
             )
+        if kind == "left":
+            # LEFT completion: (a) shipped-but-unmatched rows, read back
+            # from the received left partition (matched is per-received-row
+            # exact — every row with the same key landed on one device);
+            # (b) null-keyed live rows, which never routed (live=lnn dead
+            # through the exchange) and null-extend from the local shard —
+            # exactly the direct path's treatment of them
+            base = n_lc + n_rc
+            lrecv_live = rest[base]
+            lmatched = rest[base + 1]
+            lrecv = rest[base + 2:base + 2 + n_lc]
+            ucols = {}
+            mi = nl
+            for i, (name, c) in enumerate(left.columns.items()):
+                valid = None
+                if c.valid is not None:
+                    valid = lrecv[mi]
+                    mi += 1
+                ucols[name] = Column(
+                    lrecv[i], c.dtype, valid, c.dictionary,
+                    c.gather_stats(), owned=True,
+                )
+            un = self._compact(
+                Table(ucols, lrecv_live.shape[0]), lrecv_live & ~lmatched
+            )
+            result = self._concat(result, self._null_extend_right(un, right))
+            if any(v is not None for v in lv):
+                nk = self._compact(left, llive & ~lnn)
+                result = self._concat(
+                    result, self._null_extend_right(nk, right)
+                )
         return result
+
+    def _exchange_bytes(self, n_dev, cap_l, cap_r,
+                        lh, lk, l_ship, rh, rk, r_ship) -> int:
+        """Interconnect traffic of one exchange-join attempt: every device
+        ships n_dev buckets of cap rows per shipped array (padded-capacity
+        measure — what the collective actually moves, not just live rows),
+        plus one byte per row of live mask."""
+        per_l = 1 + sum(
+            int(a.dtype.itemsize) for a in [lh, *lk, *l_ship]
+        )
+        per_r = 1 + sum(
+            int(a.dtype.itemsize) for a in [rh, *rk, *r_ship]
+        )
+        return n_dev * n_dev * (per_l * cap_l + per_r * cap_r)
+
+    def _null_extend_right(self, t: Table, right: Table) -> Table:
+        """Append all-null right-side columns to a left-rows-only table
+        (the LEFT-join null extension), dtype/dictionary-aligned with the
+        real right columns so a later concat unifies cleanly."""
+        cols = dict(t.columns)
+        for name, c in right.columns.items():
+            cols[name] = Column(
+                jnp.zeros(t.cap, c.data.dtype), c.dtype,
+                jnp.zeros(t.cap, bool), c.dictionary,
+            )
+        return Table(cols, t.nrows_lazy, live=t.live)
 
     def _apply_residual(self, ok, li, ri, left, right, residual):
         count = K.mask_count(ok)
@@ -3037,6 +3199,8 @@ class Executor:
         lp = K.hash_columns(lk, lv) % parts
         rp = K.hash_columns(rk, rv) % parts
         segments = []
+        was_disabled = self._exchange_disabled
+        self._exchange_disabled = True
         try:
             for p in range(parts):
                 lpart = self._compact(left, (lp == p) & llive)
@@ -3054,6 +3218,8 @@ class Executor:
         except BaseException:
             pool.release(segments)
             raise
+        finally:
+            self._exchange_disabled = was_disabled
 
     def _spilled_take(self, child: Table, order, parts, op="sort"):
         """External sort tail: gather the sorted output in bounded windows

@@ -144,6 +144,11 @@ class _Entry:
         # tables) — so the claim is checked against the actual rows once
         # before any join relies on it.
         self.pk_verified = None
+        # a fact table that could not row-shard over the session mesh and
+        # fell back to full replication (Catalog._to_device); the
+        # verifier's replicated-dim rule flags scans of such tables so the
+        # fallback can never stay a log line
+        self.mesh_fallback = False
 
 
 class Catalog:
@@ -544,6 +549,26 @@ class Catalog:
                     spec = NamedSharding(mesh, PS())
                     if not warned:
                         warned = True
+                        e.mesh_fallback = True
+                        tracer = self.session.tracer
+                        if tracer is not None:
+                            # structured evidence beside the listener line:
+                            # the mesh_fallback event feeds the metrics sink
+                            # (nds_mesh_fallback_total) and the profiler, and
+                            # the entry flag above arms the verifier's
+                            # replicated-dim rule for every later plan that
+                            # scans this table
+                            tracer.emit(
+                                "mesh_fallback", table=name, n_dev=int(n_dev),
+                                cap=int(c.data.shape[0]),
+                                bytes=int(sum(
+                                    tc.data.nbytes + (
+                                        tc.valid.nbytes
+                                        if tc.valid is not None else 0
+                                    )
+                                    for tc in t.columns.values()
+                                )),
+                            )
                         self.session.notify_failure(
                             f"sharding fallback: fact table {name!r} "
                             f"(cap {c.data.shape[0]}) is not divisible by "

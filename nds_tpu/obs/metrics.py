@@ -63,6 +63,11 @@ METRIC_KINDS = {
     "nds_kernel_span_ms_total": "kernel_span",
     "nds_blocked_union_total": "blocked_union",
     "nds_blocked_union_windows_total": "blocked_union",
+    "nds_exchange_total": "exchange",
+    "nds_exchange_bytes_total": "exchange",
+    "nds_exchange_retries_total": "exchange",
+    "nds_exchange_skew": "exchange",                # gauge (latest ratio)
+    "nds_mesh_fallback_total": "mesh_fallback",
     "nds_spill_total": "spill",
     "nds_spill_bytes_in_total": "spill",
     "nds_spill_bytes_out_total": "spill",
@@ -432,6 +437,26 @@ class MetricsSink:
             kernel=kernel,
         )
 
+    def _h_exchange(self, ev):
+        self.registry.inc("nds_exchange_total", op=str(ev.get("op")))
+        self.registry.inc(
+            "nds_exchange_bytes_total", int(ev.get("bytes_moved") or 0)
+        )
+        self.registry.inc(
+            "nds_exchange_retries_total", int(ev.get("retries") or 0)
+        )
+        try:
+            self.registry.set_gauge(
+                "nds_exchange_skew", float(ev.get("skew") or 1.0)
+            )
+        except (TypeError, ValueError):
+            pass
+
+    def _h_mesh_fallback(self, ev):
+        self.registry.inc(
+            "nds_mesh_fallback_total", table=str(ev.get("table"))
+        )
+
     def _h_spill(self, ev):
         self.registry.inc("nds_spill_total", op=str(ev.get("op")))
         self.registry.inc(
@@ -672,6 +697,8 @@ _HANDLERS = {
     "pipeline_span": MetricsSink._h_pipeline_span,
     "kernel_span": MetricsSink._h_kernel_span,
     "blocked_union": MetricsSink._h_blocked_union,
+    "exchange": MetricsSink._h_exchange,
+    "mesh_fallback": MetricsSink._h_mesh_fallback,
     "spill": MetricsSink._h_spill,
     "lake_commit": MetricsSink._h_lake_commit,
     "lake_vacuum": MetricsSink._h_lake_vacuum,

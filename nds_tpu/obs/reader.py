@@ -278,6 +278,11 @@ def profile_events(events) -> dict:
         "spill_bytes_in": 0,
         "spill_bytes_out": 0,
         "spill_evictions": 0,
+        "exchange_ops": 0,
+        "exchange_bytes": 0,
+        "exchange_retries": 0,
+        "exchange_max_skew": 0.0,
+        "mesh_fallbacks": 0,
         "lake_commits": 0,
         "lake_commit_rebases": 0,
         "lake_commit_conflicts": 0,
@@ -335,6 +340,18 @@ def profile_events(events) -> dict:
             tallies["faults_injected"] += 1
         elif k == "blocked_union":
             tallies["blocked_union_windows"] += int(ev.get("windows") or 0)
+        elif k == "exchange":
+            tallies["exchange_ops"] += 1
+            tallies["exchange_bytes"] += int(ev.get("bytes_moved") or 0)
+            tallies["exchange_retries"] += int(ev.get("retries") or 0)
+            try:
+                skew = float(ev.get("skew") or 0.0)
+            except (TypeError, ValueError):
+                skew = 0.0
+            if skew > tallies["exchange_max_skew"]:
+                tallies["exchange_max_skew"] = skew
+        elif k == "mesh_fallback":
+            tallies["mesh_fallbacks"] += 1
         elif k == "spill":
             tallies["spill_ops"] += 1
             tallies["spill_bytes_in"] += int(ev.get("bytes_in") or 0)
@@ -523,7 +540,12 @@ def merge_profiles(base: dict, extra: dict) -> dict:
         dst["n_rows"] = dst.get("n_rows", 0) + int(src.get("n_rows") or 0)
     for name, v in (extra.get("tallies") or {}).items():
         base.setdefault("tallies", {})
-        base["tallies"][name] = base["tallies"].get(name, 0) + v
+        if name == "exchange_max_skew":
+            # a ratio, not a count: the merged profile reports the worst
+            # imbalance any stream saw, exactly as one raw pass would
+            base["tallies"][name] = max(base["tallies"].get(name, 0.0), v)
+        else:
+            base["tallies"][name] = base["tallies"].get(name, 0) + v
     pb_src = extra.get("plan_budget") or {}
     pb_dst = base.setdefault(
         "plan_budget",

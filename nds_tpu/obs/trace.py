@@ -102,6 +102,19 @@ EVENT_SCHEMA = {
     # (report.py; shrinks the blocked-union window before the allocator
     # fails)
     "mem_watermark": ("rss_bytes", "watermark_bytes"),
+    # one collective exchange executed under a device mesh
+    # (exec._try_exchange_join hash-partitioned join / _try_dist_sort
+    # samplesort): interconnect bytes moved (padded-capacity measure over
+    # both all_to_all passes), partition (device) count, the received-row
+    # skew ratio (max device / mean; 1.0 = perfectly balanced), and how
+    # many capacity-overflow retries the step burned before it fit
+    "exchange": ("op", "partitions", "bytes_moved", "skew", "retries"),
+    # a fact table could not row-shard over the session mesh (capacity not
+    # divisible by the device count) and fell back to full replication
+    # (session.Catalog._to_device) — loud by contract: the event feeds a
+    # metric family and the entry flag arms the verifier's replicated-dim
+    # rule. Optional: bytes (host-side table size now copied per device).
+    "mesh_fallback": ("table", "n_dev", "cap"),
     # one out-of-core (spilled) operator execution (engine/spill.py +
     # exec's _spilled_join/_spilled_take/_spilled_distinct): host-pool
     # traffic for a partitioned hash join / external sort / spilling

@@ -205,10 +205,46 @@ def _compact_full(mask: jnp.ndarray) -> jnp.ndarray:
     )
 
 
+def _multi_device(x) -> bool:
+    """True for a CONCRETE array actually sharded across > 1 device (mesh
+    sessions). Tracers/host arrays report False — traced callers keep the
+    single-device kernel choice, which is correct there by construction."""
+    s = getattr(x, "sharding", None)
+    if s is None:
+        return False
+    try:
+        return len(s.device_set) > 1
+    except Exception:
+        return False
+
+
+@partial(jax.jit, static_argnames=())
+def _compact_full_sorted(mask: jnp.ndarray) -> jnp.ndarray:
+    """_compact_full via the canonical kv-sort kernel: a stable ascending
+    sort of (dead, index) puts live indices first in original order —
+    identical output to the cumsum+scatter path (zeros past the count).
+
+    This is the MESH-SAFE variant: jax 0.4.37's SPMD partitioner
+    mislowers the blocked fast_cumsum -> where -> scatter(mode="drop")
+    composition over a row-sharded mask (cross-shard scatter writes are
+    dropped, so compaction silently truncates — caught by the SF0.01
+    mesh-vs-oracle gate on query77/query83). The sort kernel partitions
+    correctly, so sharded masks route here instead."""
+    n = mask.shape[0]
+    perm = sort_by_words([(~mask).astype(jnp.int64)])
+    count = jnp.sum(mask, dtype=jnp.int32)
+    return jnp.where(
+        jnp.arange(n, dtype=jnp.int32) < count, perm.astype(jnp.int32), 0
+    )
+
+
 @_ktraced("compact_indices")
 def compact_indices(mask: jnp.ndarray, out_cap: int) -> jnp.ndarray:
     """Indices of True entries, padded with 0 to out_cap."""
-    full = _compact_full(mask)
+    if _multi_device(mask):
+        full = _compact_full_sorted(mask)
+    else:
+        full = _compact_full(mask)
     n = mask.shape[0]
     if out_cap <= n:
         return jax.lax.slice(full, (0,), (out_cap,))

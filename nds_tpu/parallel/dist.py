@@ -174,20 +174,32 @@ def exchange_hash_join(
     cap_l: int,
     cap_r: int,
     pair_cap: int,
+    kind: str = "inner",
 ):
-    """Factory for the mesh fact-fact inner join step.
+    """Factory for the mesh fact-fact join step (inner or left).
 
     The returned jitted fn takes
       (l_hash, l_live, l_keys..., l_cols...),
       (r_hash, r_live, r_keys..., r_cols...)
-    as flat tuples and returns per-device-concatenated pair outputs:
-      (pair_ok [n_dev*pair_cap], l_out cols..., r_out cols...,
-       overflow scalar)
-    where pair_ok marks verified join pairs (hash candidates re-checked
-    against the real key columns, so collisions can never fabricate rows)
-    and overflow > 0 means some bucket or pair capacity was exceeded — the
+    as flat tuples and returns per-device-concatenated outputs:
+
+      inner: (pair_ok [n_dev*pair_cap], l_out cols..., r_out cols...,
+              recv_counts [n_dev], overflow scalar)
+      left:  inner's outputs plus, before recv_counts:
+             (l_recv_live [n_dev*cap_l], l_matched [n_dev*cap_l],
+              l_recv cols... [n_dev*cap_l])
+
+    pair_ok marks verified join pairs (hash candidates re-checked against
+    the real key columns, so collisions can never fabricate rows). For a
+    LEFT join the caller null-extends `l_recv_live & ~l_matched` rows (the
+    shipped-but-unmatched left rows; null-keyed rows never route and stay
+    the caller's problem). `recv_counts` is the per-device count of live
+    received left rows — the skew evidence the `exchange` trace event
+    reports (max/mean > 1 means the hash partitioning is imbalanced).
+    overflow > 0 means some bucket or pair capacity was exceeded — the
     caller must retry with larger caps (executor emits a task-failure event
-    and doubles, like a Spark shuffle-spill retry).
+    and doubles, like a Spark shuffle-spill retry) and must not trust any
+    other output of that attempt.
     """
     n_dev = mesh.devices.size
     imax = jnp.iinfo(jnp.int64).max
@@ -226,14 +238,34 @@ def exchange_hash_join(
             ok = ok & (a[li] == b[ri])
         ov_pairs = jnp.maximum(total - pair_cap, 0)
         overflow = ovl + ovr + jax.lax.psum(ov_pairs, "data")
+        # per-device received-row counts as a psum'd one-hot (psum output
+        # is provably replicated, which shard_map's rep check can infer;
+        # a bare all_gather here is not)
+        d_idx = jax.lax.axis_index("data")
+        recv_counts = jax.lax.psum(
+            jnp.zeros(n_dev, jnp.int64).at[d_idx].set(llive2.sum()), "data"
+        )
         l_out = [c[li] for c in lcols2]
         r_out = [c[ri] for c in rcols2]
-        return (ok, *l_out, *r_out, overflow)
+        if kind == "left":
+            # matched = >= 1 verified pair enumerated for the received row
+            # (only trustworthy when overflow == 0 — truncated pair
+            # enumeration could miss a row's single match)
+            lmatched = jnp.zeros(lh2.shape[0], bool).at[li].max(ok)
+            return (
+                ok, *l_out, *r_out, llive2, lmatched, *lcols2,
+                recv_counts, overflow,
+            )
+        return (ok, *l_out, *r_out, recv_counts, overflow)
 
+    left_extra = (
+        tuple(P("data") for _ in range(2 + n_lcols)) if kind == "left" else ()
+    )
     out_specs = (
         (P("data"),)
         + tuple(P("data") for _ in range(n_lcols + n_rcols))
-        + (P(),)
+        + left_extra
+        + (P(), P())
     )
     fn = shard_map(
         local,
@@ -261,7 +293,8 @@ def sample_sort(mesh: Mesh, n_keys: int, n_cols: int, cap_route: int,
     """Factory for the mesh samplesort step.
 
     The returned jitted fn takes (route, live, key..., col...), all sharded on
-    the `data` axis, and returns (live_out, col_out..., overflow):
+    the `data` axis, and returns
+    (live_out, col_out..., recv_counts [n_dev], overflow):
 
       * `route` — one comparable value per row, monotone in the most-
         significant sort key (nulls pre-folded to that dtype's extremes);
@@ -276,7 +309,10 @@ def sample_sort(mesh: Mesh, n_keys: int, n_cols: int, cap_route: int,
 
     overflow > 0 means a routing bucket exceeded cap_route (key skew); the
     caller must retry with a doubled cap (cap_route == local rows can never
-    overflow).
+    overflow). `recv_counts` is the per-device count of live rows received
+    in the range-partitioning pass — the skew evidence for the `exchange`
+    trace event (splitter sampling keeps it near-balanced except under
+    heavy duplicate-key mass).
     """
     n_dev = mesh.devices.size
 
@@ -319,6 +355,11 @@ def sample_sort(mesh: Mesh, n_keys: int, n_cols: int, cap_route: int,
         nl2 = live2.sum()
         counts = jax.lax.all_gather(nl2, "data")
         d_idx = jax.lax.axis_index("data")
+        # skew evidence output: psum'd one-hot (provably replicated under
+        # the rep check, unlike the all_gather above)
+        recv_counts = jax.lax.psum(
+            jnp.zeros(n_dev, jnp.int64).at[d_idx].set(nl2), "data"
+        )
         start = jnp.where(jnp.arange(n_dev) < d_idx, counts, 0).sum()
         rank = start + jnp.arange(live2.shape[0], dtype=jnp.int64)
         dest2 = jnp.where(live2, (rank // n).astype(jnp.int32), n_dev)
@@ -343,7 +384,7 @@ def sample_sort(mesh: Mesh, n_keys: int, n_cols: int, cap_route: int,
                     jnp.where(placed, buf, jnp.zeros((), c.dtype)).sum(axis=0)
                 )
         live_out = placed.any(axis=0)
-        return (live_out, *outs, overflow)
+        return (live_out, *outs, recv_counts, overflow)
 
     fn = shard_map(
         local,
@@ -351,7 +392,7 @@ def sample_sort(mesh: Mesh, n_keys: int, n_cols: int, cap_route: int,
         in_specs=tuple(P("data") for _ in range(2 + n_keys + n_cols)),
         out_specs=(P("data"),)
         + tuple(P("data") for _ in range(n_cols))
-        + (P(),),
+        + (P(), P()),
     )
     return jax.jit(fn)
 
@@ -372,15 +413,17 @@ def get_sample_sort(mesh, n_keys, n_cols, cap_route, n_samples=64):
 _XJOIN_CACHE = {}
 
 
-def get_exchange_hash_join(mesh, n_lkeys, n_lcols, n_rcols, cap_l, cap_r, pair_cap):
+def get_exchange_hash_join(mesh, n_lkeys, n_lcols, n_rcols, cap_l, cap_r,
+                           pair_cap, kind="inner"):
     """Cached factory: one compiled exchange-join step per signature, so
     repeated joins across a query stream reuse the XLA executable. Keyed by
     the mesh's device topology (not object identity, which a recycled id()
     could alias after GC)."""
     topo = tuple(d.id for d in mesh.devices.flat)
-    key = (topo, n_lkeys, n_lcols, n_rcols, cap_l, cap_r, pair_cap)
+    key = (topo, n_lkeys, n_lcols, n_rcols, cap_l, cap_r, pair_cap, kind)
     if key not in _XJOIN_CACHE:
         _XJOIN_CACHE[key] = exchange_hash_join(
-            mesh, n_lkeys, n_lcols, n_rcols, cap_l, cap_r, pair_cap
+            mesh, n_lkeys, n_lcols, n_rcols, cap_l, cap_r, pair_cap,
+            kind=kind,
         )
     return _XJOIN_CACHE[key]

@@ -17,7 +17,33 @@ table through one coordinator (the reference's HDFS-read equivalent).
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
+
+
+def _enable_cpu_collectives(jax) -> None:
+    """Cross-process collectives on the CPU backend need an explicit
+    implementation — jax's default ("none") raises "Multiprocess
+    computations aren't implemented on the CPU backend", which kept the
+    two-process DCN tier skipped on CPU since PR 3. Gloo rides the same
+    TCP world the distributed coordinator already set up, so a CPU fleet
+    (and the CI gate) gets real cross-process psum/all_to_all. Config
+    must land BEFORE the backend initializes; only touched when the
+    process is pinned to the CPU platform — TPU pods keep native ICI/DCN
+    collectives."""
+    try:
+        platforms = str(
+            getattr(jax.config, "jax_platforms", None)
+            or os.environ.get("JAX_PLATFORMS")
+            or ""
+        )
+        if "cpu" in platforms.lower():
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        # older/newer jax without the knob: initialize() then surfaces the
+        # real capability error instead of this helper masking it
+        pass
 
 
 def initialize(coordinator_address=None, num_processes=None, process_id=None):
@@ -45,6 +71,7 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None):
             raise ValueError(
                 "coordinator_address requires num_processes and process_id"
             )
+        _enable_cpu_collectives(jax)
         try:
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,
@@ -64,6 +91,7 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None):
     # no arguments: rely on cluster auto-detection (TPU pod metadata, SLURM).
     # A plain single-host environment has nothing to detect — initialize()
     # raises there, which is the expected no-op path.
+    _enable_cpu_collectives(jax)
     try:
         jax.distributed.initialize()
     except Exception:

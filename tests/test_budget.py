@@ -143,6 +143,94 @@ def test_round5_oom_set_flagged_at_sf10():
         assert all(v != "direct" for v in verdicts), (q, verdicts)
 
 
+def test_mesh_mode_divides_sharded_bytes_by_mesh_width():
+    """Per-device model (ISSUE 13): fact-scan bytes divide by the mesh
+    width, replicated dimension bytes are charged in full per device, and
+    the single-device model is byte-identical to mesh_devices=None."""
+    sess = _schema_session()
+    (plan,) = _template_plan(sess, 3, 10.0)
+    pb1 = B.analyze_plan(plan, sess.catalog, scale_factor=10.0)
+    pb8 = B.analyze_plan(plan, sess.catalog, scale_factor=10.0,
+                         mesh_devices=8)
+    assert pb8.mesh_devices == 8 and pb1.mesh_devices is None
+    assert pb8.peak_bytes < pb1.peak_bytes
+    by_desc1 = {id(n.node): n for n in pb1.nodes}
+    fact = dim = False
+    for n8 in pb8.nodes:
+        n1 = by_desc1.get(id(n8.node))
+        if n1 is None or not n8.desc.startswith("Scan"):
+            continue
+        if n8.sharded:
+            fact = True
+            assert n8.alloc_bytes == n1.alloc_bytes // 8, n8.desc
+        else:
+            dim = True
+            assert n8.alloc_bytes == n1.alloc_bytes, n8.desc  # per device
+    assert fact and dim
+    # identity widths: mesh_devices absent or 1 changes nothing
+    pb_one = B.analyze_plan(plan, sess.catalog, scale_factor=10.0,
+                            mesh_devices=1)
+    assert pb_one.peak_bytes == pb1.peak_bytes
+    # the per-device table says so
+    assert "per device" in pb8.table() and "[sharded]" in pb8.table()
+
+
+def test_mesh_mode_sf10_oom_set_goes_direct_per_device():
+    """The round-5 SF10 OOM set (q5 blocked, q6/q7 spill single-device)
+    admits DIRECT on the 8-device mesh — each chip's share of the sharded
+    fact work fits; same pins the corpus --budget gate holds."""
+    for q, single in ((5, "blocked"), (6, "spill"), (7, "spill")):
+        sess = _schema_session()
+        (plan,) = _template_plan(sess, q, 10.0)
+        pb1 = B.analyze_plan(plan, sess.catalog, scale_factor=10.0)
+        assert pb1.verdict == single, (q, pb1.verdict)
+        pb8 = B.analyze_plan(plan, sess.catalog, scale_factor=10.0,
+                             mesh_devices=8)
+        assert pb8.verdict == "direct", (q, pb8.verdict)
+        assert pb8.peak_bytes <= pb8.budget_bytes
+
+
+def test_session_mesh_devices_resolution():
+    """Width resolution: live session mesh wins, engine.mesh_devices conf
+    covers schema-only contexts ONLY, <= 1 means single-device model."""
+    sess = _schema_session()
+    assert B.session_mesh_devices(sess) is None
+    sess.conf["engine.mesh_devices"] = 8
+    assert B.session_mesh_devices(sess) == 8
+    sess.conf["engine.mesh_devices"] = 1
+    assert B.session_mesh_devices(sess) is None
+    sess.conf["engine.mesh_devices"] = "bogus"
+    assert B.session_mesh_devices(sess) is None
+    sess.mesh = _FakeMesh(4)
+    assert B.session_mesh_devices(sess) == 4
+    # a session with REAL data but no mesh executes single-device: a
+    # stray conf key must not buy per-device admission verdicts for
+    # plans that will run on one chip
+    import pyarrow as pa
+
+    live = _schema_session()
+    live.conf["engine.mesh_devices"] = 8
+    live.register_arrow("t", pa.table({"a": [1, 2, 3]}))
+    assert B.session_mesh_devices(live) is None
+    live.mesh = _FakeMesh(8)  # the live mesh still wins over everything
+    assert B.session_mesh_devices(live) == 8
+
+
+def test_budget_plan_records_mesh_devices_on_session():
+    """The in-session hook (the one serve-mode admission consumes) models
+    per-device under engine.mesh_devices and records the width."""
+    sess = _schema_session()
+    sess.conf["engine.plan_budget"] = "on"
+    sess.conf["engine.plan_budget_sf"] = 10.0
+    sess.conf["engine.mesh_devices"] = 8
+    _template_plan(sess, 5, 10.0)
+    rec = sess.last_plan_budget
+    assert rec["verdict"] == "direct" and rec["mesh_devices"] == 8
+    # q14: reject single-device, admitted per-device at 8 chips
+    _template_plan(sess, 14, 10.0)
+    assert sess.last_plan_budget["verdict"] == "direct"
+
+
 def test_reject_raises_classified_planner():
     # q14's SF10 estimate is far beyond the reject line; with the
     # in-session hook ON it must refuse the statement at plan time

@@ -109,9 +109,11 @@ def test_sample_sort_global_order(mesh):
     live = jax.device_put(jnp.asarray(rng.random(n) < 0.9), shard)
 
     fn = sample_sort(mesh, n_keys=1, n_cols=2, cap_route=64)
-    live_out, k_out, v_out, ov = jax.block_until_ready(
+    live_out, k_out, v_out, counts, ov = jax.block_until_ready(
         fn(keys, live, keys, keys, vals))
     assert int(ov) == 0
+    # skew evidence: per-device received counts cover every live row
+    assert int(np.asarray(counts).sum()) == int(np.asarray(live).sum())
     k_host, v_host, l_host = (np.asarray(x) for x in (keys, vals, live))
     L = int(l_host.sum())
     lo, ko, vo = (np.asarray(x) for x in (live_out, k_out, v_out))
@@ -141,9 +143,36 @@ def test_sample_sort_skew_overflow_and_max_cap(mesh):
     assert int(ov) > 0  # skew detected, caller must retry
 
     big = sample_sort(mesh, n_keys=1, n_cols=1, cap_route=local)
-    live_out, k_out, ov = jax.block_until_ready(big(keys, live, keys, keys))
+    live_out, k_out, counts, ov = jax.block_until_ready(
+        big(keys, live, keys, keys))
     assert int(ov) == 0  # cap == local rows can never overflow
     np.testing.assert_array_equal(np.asarray(k_out)[: n], np.sort(raw))
+    # the hot key's rows all land on one device: skew is visible in the
+    # received counts (max well above the balanced share)
+    c = np.asarray(counts)
+    assert c.max() > 2 * c.sum() / len(c)
+
+
+def test_compact_indices_sharded_matches_replicated(mesh):
+    """Regression (caught by the SF0.01 mesh gate on query77/query83):
+    jax 0.4.37's SPMD partitioner mislowers the blocked-cumsum + scatter
+    compaction over a row-sharded mask — cross-shard scatter writes drop
+    and compaction silently truncates. Sharded masks must route through
+    the sort-based variant and agree with the single-device kernel
+    exactly (indices AND zero padding)."""
+    from nds_tpu.ops import kernels as K
+
+    shard = NamedSharding(mesh, P("data"))
+    rng = np.random.default_rng(12)
+    for n in (1024, 8192):
+        for frac in (0.0, 0.3, 1.0):
+            mask_np = rng.random(n) < frac
+            mask_s = jax.device_put(jnp.asarray(mask_np), shard)
+            mask_r = jnp.asarray(mask_np)
+            for cap in (n // 2, n, 2 * n):
+                a = np.asarray(K.compact_indices(mask_s, cap))
+                b = np.asarray(K.compact_indices(mask_r, cap))
+                np.testing.assert_array_equal(a, b, err_msg=str((n, frac, cap)))
 
 
 def test_multihost_single_process_degenerates(mesh):

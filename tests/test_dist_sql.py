@@ -247,17 +247,294 @@ def test_exchange_join_overflow_retries():
     assert any("exchange join" in f for f in failures)
 
 
+def _assert_tables_equal(a, b, tol=1e-9, ctx=""):
+    assert a.schema.names == b.schema.names, ctx
+    assert a.num_rows == b.num_rows, ctx
+    for col in a.schema.names:
+        for x, y in zip(a.column(col).to_pylist(), b.column(col).to_pylist()):
+            if isinstance(x, float) and isinstance(y, float):
+                assert abs(x - y) < tol or (np.isnan(x) and np.isnan(y)), (
+                    ctx, col, x, y,
+                )
+            else:
+                assert x == y, (ctx, col, x, y)
+
+
+def _exchange_pair(conf=None, tables=None, mesh_devs=N_DEV):
+    conf = {"engine.exchange_min_rows": 1, **(conf or {})}
+    oracle = Session(conf=dict(conf))
+    dist = Session(mesh=make_mesh(mesh_devs), conf=dict(conf))
+    for name, t in (tables or {}).items():
+        oracle.register_arrow(name, t)
+        dist.register_arrow(name, t)
+    return oracle, dist
+
+
+def _spy_exchange(monkeypatch):
+    """Record every _try_exchange_join outcome so tests can assert the
+    exchange path actually carried the join (not a silent fallback)."""
+    from nds_tpu.engine import exec as X
+
+    taken = []
+    orig = X.Executor._try_exchange_join
+
+    def spy(self, *a, **kw):
+        r = orig(self, *a, **kw)
+        taken.append(r is not None)
+        return r
+
+    monkeypatch.setattr(X.Executor, "_try_exchange_join", spy)
+    return taken
+
+
+def test_exchange_left_join_null_keys_match_oracle(monkeypatch):
+    """LEFT join through the exchange: null-keyed left rows never route but
+    MUST survive null-extended, and shipped-but-unmatched rows null-extend
+    from the received partition — bit-identical to the single-device path
+    (ISSUE 13 satellite: null-keyed LEFT rows surviving the exchange)."""
+    taken = _spy_exchange(monkeypatch)
+    rng = np.random.default_rng(23)
+    n = 4096
+    # sparse key domain keeps the dense star-join fast path out of the way
+    k = (rng.integers(0, 512, n) * 1_000_003).astype(object)
+    k[rng.random(n) < 0.07] = None  # null keys: must null-extend, not drop
+    left = pa.table({
+        "k": pa.array(k, pa.int64()),
+        "lv": np.arange(n, dtype=np.int64),
+    })
+    # right misses half the key domain -> plenty of unmatched left rows
+    right = pa.table({
+        "k": np.arange(0, 512, 2, dtype=np.int64) * 1_000_003,
+        "rv": np.arange(256, dtype=np.int64) * 10,
+    })
+    oracle, dist = _exchange_pair(tables={"l": left, "r": right})
+    q = ("select l.k, lv, rv from l left join r on l.k = r.k "
+         "order by lv, rv")
+    _assert_tables_equal(
+        oracle.sql(q).collect(), dist.sql(q).collect(), ctx="left-null"
+    )
+    assert any(taken), "exchange join path was never exercised"
+    # aggregate form too (null-keyed rows count, rv sums skip nulls)
+    q2 = ("select count(*) c, count(rv) cr, sum(lv) sl, sum(rv) sr "
+          "from l left join r on l.k = r.k")
+    assert oracle.sql(q2).to_pylist() == dist.sql(q2).to_pylist()
+
+
+def test_exchange_join_hot_key_skew_matches_oracle(monkeypatch):
+    """One key owning >50% of the rows: the hot destination overflows the
+    balanced capacity guess, the retry doubles it, and the result still
+    equals the oracle — with the skew visible in the `exchange` event."""
+    from nds_tpu.obs.trace import Tracer
+
+    taken = _spy_exchange(monkeypatch)
+    rng = np.random.default_rng(31)
+    n = 8192
+    hot = rng.random(n) < 0.6  # 60% of rows share ONE key
+    k = np.where(hot, 13, rng.integers(0, 1024, n)) * 1_000_003
+    left = pa.table({"k": k, "lv": np.arange(n, dtype=np.int64)})
+    right = pa.table({
+        "k": np.arange(1024, dtype=np.int64) * 1_000_003,
+        "rv": np.arange(1024, dtype=np.int64),
+    })
+    oracle, dist = _exchange_pair(tables={"l": left, "r": right})
+    tracer = Tracer(None)  # in-memory collector
+    dist.tracer = tracer
+    q = ("select count(*) c, sum(lv) sl, sum(rv) sr from l, r "
+         "where l.k = r.k")
+    a = oracle.sql(q).collect()
+    b = dist.sql(q).collect()
+    assert a.to_pylist() == b.to_pylist()
+    assert any(taken)
+    ex = [e for e in tracer.events if e["kind"] == "exchange"]
+    assert ex, "no exchange trace evidence"
+    assert any(e["skew"] > 2.0 for e in ex), ex  # hot key -> imbalance
+    assert all(e["bytes_moved"] > 0 and e["partitions"] == N_DEV
+               for e in ex)
+
+
+def test_exchange_join_empty_partitions_match_oracle(monkeypatch):
+    """Keys covering only 2 of 8 destinations: six devices receive ZERO
+    rows and the join must still equal the oracle (the empty-partition
+    searchsorted/compaction edge)."""
+    taken = _spy_exchange(monkeypatch)
+    rng = np.random.default_rng(37)
+    n = 4096
+    # destination = hash(key) % n_dev: with only TWO distinct left keys at
+    # most two devices receive left rows — at least six work on empty
+    # received partitions (sparse values keep the dense path out)
+    k = np.where(rng.random(n) < 0.5, 7, 11) * 1_000_003
+    left = pa.table({"k": k, "lv": np.arange(n, dtype=np.int64)})
+    right = pa.table({
+        "k": np.arange(0, 256, dtype=np.int64) * 1_000_003,
+        "rv": np.arange(256, dtype=np.int64),
+    })
+    oracle, dist = _exchange_pair(tables={"l": left, "r": right})
+    q = ("select count(*) c, sum(lv) sl, sum(rv) sr from l, r "
+         "where l.k = r.k")
+    assert oracle.sql(q).to_pylist() == dist.sql(q).to_pylist()
+    # left-join flavor rides the same received partitions
+    q2 = ("select count(*) c, count(rv) cr from l left join r "
+          "on l.k = r.k")
+    assert oracle.sql(q2).to_pylist() == dist.sql(q2).to_pylist()
+    assert any(taken)
+
+
+def test_exchange_persistent_overflow_tiers_through_spill_pool(monkeypatch):
+    """Single-key-scale skew a hash partitioning can never split: every
+    retry re-overflows, and the join must tier through the host spill pool
+    (planned degradation composing with scale-out) instead of aborting —
+    still oracle-equal, with spill evidence recorded."""
+    from nds_tpu.engine import exec as X
+
+    # force every attempt to report overflow so the retry loop exhausts
+    taken = _spy_exchange(monkeypatch)
+    n = 4096
+    # ONE (sparse) key owns the table; sparse values decline the dense path
+    k = np.full(n, 7 * 1_000_003, dtype=np.int64)
+    left = pa.table({"k": k, "lv": np.arange(n, dtype=np.int64)})
+    right = pa.table({"k": np.array([7, 9], dtype=np.int64) * 1_000_003,
+                      "rv": np.array([1, 2], dtype=np.int64)})
+    monkeypatch.setattr(X.Executor, "_EXCHANGE_MAX_ATTEMPTS", 0)
+    oracle, dist = _exchange_pair(tables={"l": left, "r": right})
+    failures = []
+    dist.register_listener(failures.append)
+    q = "select count(*) c, sum(lv) sl, sum(rv) sr from l, r where l.k = r.k"
+    a = oracle.sql(q).collect()
+    b = dist.sql(q).collect()
+    assert a.to_pylist() == b.to_pylist()
+    assert any("spill pool" in f for f in failures), failures
+    assert dist.last_spill is not None and dist.last_spill["ops"] >= 1
+    assert any(taken)
+
+
+def test_semi_filtered_dim_join_matches_oracle():
+    """Regression for the query83/query77 mesh mismatch the SF0.01 gate
+    caught: a sharded fact joined against a SEMI-filtered replicated dim
+    compacts the masked dim through compact_indices — whose cumsum+scatter
+    kernel the SPMD partitioner mislowers on sharded masks (rows silently
+    dropped). The full shape must equal the single-device oracle."""
+    rng = np.random.default_rng(5)
+    nd = 73049
+    dim_sk = np.arange(2415022, 2415022 + nd, dtype=np.int64)
+    dval = np.array([f"v{i % 97}" for i in range(nd)])
+    nf = 736  # the SF0.01 web_returns scale that exposed the truncation
+    fact = pa.table({
+        "wr_returned_date_sk": rng.choice(dim_sk, nf),
+        "wr_return_quantity": rng.integers(1, 50, nf),
+    })
+    dim = pa.table({
+        "d_date_sk": dim_sk, "d_date": dval,
+        "d_week_seq": (np.arange(nd) // 7).astype(np.int64),
+    })
+    oracle_s = Session()
+    dist_s = Session(mesh=make_mesh(N_DEV))
+    for s in (oracle_s, dist_s):
+        s.register_arrow("web_returns", fact)  # fact name -> row-sharded
+        s.register_arrow("date_dim", dim)
+    q = """select count(*) c, sum(wr_return_quantity) s
+           from web_returns, date_dim
+           where d_date in (select d_date from date_dim where d_week_seq in
+               (select d_week_seq from date_dim where d_date in ('v3','v5')))
+           and wr_returned_date_sk = d_date_sk"""
+    a = oracle_s.sql(q).to_pylist()
+    b = dist_s.sql(q).to_pylist()
+    assert a == b and a[0]["c"] > 0, (a, b)
+
+
+def test_sharded_agg_partial_merge_matches_oracle(dist, oracle):
+    """Decomposable aggregates over a row-sharded fact reduce per shard and
+    merge (the scatter-add lowers to per-chip partials + cross-chip merge
+    under GSPMD) — sums/counts/extremes/avg must equal the oracle."""
+    q = """
+        select ss_quantity bucket, count(*) c, sum(ss_item_sk) s,
+               min(ss_ext_sales_price) mn, max(ss_ext_sales_price) mx,
+               avg(ss_ticket_number) aq
+        from store_sales group by ss_quantity order by bucket
+    """
+    _assert_tables_equal(
+        oracle.sql(q).collect(), dist.sql(q).collect(), ctx="agg-merge"
+    )
+
+
 def test_sharding_fallback_is_loud():
     """A mesh that can't divide the fact-table capacity must announce the
     replication fallback through the listener chain, never degrade silently
-    (VERDICT r2 weak #3)."""
+    (VERDICT r2 weak #3) — and since ISSUE 13 additionally emit a
+    `mesh_fallback` trace event (schema-valid, metric-counted), record the
+    fallback on the catalog entry, and have the verifier's replicated-dim
+    rule flag every later plan scanning the replicated fact."""
+    from nds_tpu.analysis.verifier import PlanVerifier
+    from nds_tpu.engine import plan as P
+    from nds_tpu.obs.metrics import MetricsSink
+    from nds_tpu.obs.reader import validate_events
+    from nds_tpu.obs.trace import Tracer
+
     s = Session(mesh=make_mesh(3))
+    tracer = Tracer(None)  # in-memory collector
+    tracer.sink = MetricsSink()
+    s.tracer = tracer
     events = []
     s.register_listener(events.append)
     for name, t in _synth_tables().items():
         s.register_arrow(name, t)
     s.catalog.load("store_sales", ["ss_item_sk"])
     assert any("sharding fallback" in e for e in events)
+    fb = [e for e in tracer.events if e["kind"] == "mesh_fallback"]
+    assert fb and fb[0]["table"] == "store_sales" and fb[0]["n_dev"] == 3
+    assert fb[0]["bytes"] > 0
+    validate_events(tracer.events)  # schema contract holds
+    assert (
+        tracer.sink.registry.counter_value(
+            "nds_mesh_fallback_total", table="store_sales"
+        )
+        == 1
+    )
+    assert s.catalog.entries["store_sales"].mesh_fallback
+    # the verifier flags every later plan that scans the replicated fact
+    plan = P.Scan("store_sales", "store_sales", ["ss_item_sk"])
+    v = PlanVerifier(s.catalog).verify(plan, mesh=make_mesh(3))
+    assert any(
+        "replicated-dim" in x and "mesh fallback" in x for x in v
+    ), v
+
+
+def test_profile_compare_multichip_rounds(tmp_path):
+    """`profile --bench` MULTICHIP mode: an old driver-wrapper round
+    ({ok, tail} only — r01–r05 predate the metrics block) compares
+    fail-soft (old_ratio null), a worsened mesh-vs-oracle ratio or an
+    ok->not-ok flip flags regression, and the --bench handler routes
+    multichip artifacts away from the sqlite_shared comparison."""
+    import json
+
+    from nds_tpu.cli.profile import _compare_multichip
+
+    old_wrapper = tmp_path / "MULTICHIP_r05.json"
+    old_wrapper.write_text(json.dumps(
+        {"n_devices": 8, "rc": 0, "ok": True, "tail": "dryrun ok"}
+    ))
+    new_block = tmp_path / "gate.json"
+    new_block.write_text(json.dumps({
+        "n_devices": 8, "ok": True, "matched": 103,
+        "mesh_vs_oracle_wall_ratio": 2.5,
+    }))
+    (rec,) = _compare_multichip(str(old_wrapper), str(new_block))
+    assert rec["change"] == "headline" and rec["old_ratio"] is None
+    assert rec["new_ratio"] == 2.5 and rec["queries"] == 103
+    # ok -> not-ok is a regression even without ratios
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"n_devices": 8, "ok": False, "matched": 1}))
+    (rec2,) = _compare_multichip(str(old_wrapper), str(bad))
+    assert rec2["change"] == "regression"
+    # ratio worsening > 25% between two metric rounds flags too
+    older = tmp_path / "older.json"
+    older.write_text(json.dumps({
+        "n_devices": 8, "ok": True, "mesh_vs_oracle_wall_ratio": 1.5,
+    }))
+    (rec3,) = _compare_multichip(str(older), str(new_block))
+    assert rec3["change"] == "regression"
+    # unreadable new artifact degrades to a status_change record
+    (rec4,) = _compare_multichip(str(old_wrapper), str(tmp_path / "nope"))
+    assert rec4["change"] == "status_change"
 
 
 def test_fact_columns_are_row_sharded(dist):

@@ -99,8 +99,11 @@ _VERDICT_RANK = {"direct": 0, "unknown": 1, "blocked": 2, "spill": 3,
 
 
 def budget_pass(use_decimal: bool, rngseed: int) -> int:
-    """Schema-only budget estimates for every template at SF1 and SF10;
-    returns the number of calibration failures (0 == gate passes)."""
+    """Schema-only budget estimates for every template at SF1 and SF10
+    (plus the SF10 per-device mesh model — the same plans analyzed under
+    mesh_devices=MESH_DEVICES in the one sweep, so the corpus plans each
+    template once); returns the number of calibration failures (0 ==
+    gate passes)."""
     from nds_tpu.analysis import budget as B
 
     failures = 0
@@ -111,12 +114,16 @@ def budget_pass(use_decimal: bool, rngseed: int) -> int:
         sess.conf["engine.plan_budget"] = "off"
         verdicts = {}
         peaks = {}
+        mesh_verdicts = {}
+        mesh_peaks = {}
         t0 = perf_counter()
         for q in available_templates():
             rng = np.random.default_rng(np.random.SeedSequence([rngseed, 0]))
             sql = instantiate(q, rng, sf)
             worst = "direct"
             peak = 0
+            m_worst = "direct"
+            m_peak = 0
             for stmt in parse_script(sql):
                 res = sess.run_stmt(stmt)
                 pb = B.analyze_plan(
@@ -125,8 +132,19 @@ def budget_pass(use_decimal: bool, rngseed: int) -> int:
                 if _VERDICT_RANK[pb.verdict] > _VERDICT_RANK[worst]:
                     worst = pb.verdict
                 peak = max(peak, pb.peak_bytes)
+                if sf == 10.0:
+                    mb = B.analyze_plan(
+                        res.plan, sess.catalog, scale_factor=sf,
+                        mesh_devices=MESH_DEVICES,
+                    )
+                    if _VERDICT_RANK[mb.verdict] > _VERDICT_RANK[m_worst]:
+                        m_worst = mb.verdict
+                    m_peak = max(m_peak, mb.peak_bytes)
             verdicts[q] = worst
             peaks[q] = peak
+            if sf == 10.0:
+                mesh_verdicts[q] = m_worst
+                mesh_peaks[q] = m_peak
         dt = perf_counter() - t0
         flagged = sorted(q for q, v in verdicts.items() if v != "direct")
         print(
@@ -171,6 +189,60 @@ def budget_pass(use_decimal: bool, rngseed: int) -> int:
                     ">= 90% of the round-5 SF10 device-OOM set onto the "
                     f"{PLANNED_DEGRADATION} verdicts"
                 )
+            failures += _check_mesh_pins(mesh_verdicts, mesh_peaks)
+    return failures
+
+
+#: mesh width of the per-device calibration pass (the CI mesh gate's and
+#: the virtual CPU test mesh's width)
+MESH_DEVICES = 8
+
+#: templates still rejected per-device at SF10 on the 8-wide mesh: q47's
+#: fact-scale window function all-gathers under the generic rewrite (the
+#: budgeter charges it in full per chip — honestly), so it stays beyond
+#: the reject line until a distributed window rewrite lands. Everything
+#: else admits — incl. the single-device reject set (q14/q23 and kin).
+EXPECTED_MESH_REJECTS = (47,)
+
+
+def _check_mesh_pins(verdicts: dict, peaks: dict) -> int:
+    """Per-device calibration pins at SF10 over the 8-device mesh
+    (ISSUE 13; verdicts/peaks computed in budget_pass's SF10 sweep so
+    templates plan once): sharded node bytes divide by the mesh width,
+    replicated dims are charged per chip. The round-5 device-OOM set
+    (q5/q6/q7 — blocked/spill single-device) must re-derive to per-device
+    `direct` (each chip's share fits), and the reject set must equal the
+    pinned EXPECTED_MESH_REJECTS — scale-out admits everything else.
+    Returns the number of calibration failures."""
+    failures = 0
+    detail = ", ".join(
+        f"q{q}={verdicts[q]}@{peaks[q] / (1 << 30):.2f}G"
+        for q in ROUND5_SF10_OOM
+    )
+    rejects = sorted(q for q, v in verdicts.items() if v == "reject")
+    print(
+        f"plan_budget_corpus: SF10 x {MESH_DEVICES}-device mesh "
+        f"(per-device): OOM set {detail}; {len(rejects)} reject(s)"
+    )
+    bad = [q for q in ROUND5_SF10_OOM if verdicts[q] != "direct"]
+    if bad:
+        failures += 1
+        print(
+            f"plan_budget_corpus: FAIL: the round-5 SF10 OOM set must "
+            f"re-derive to per-device `direct` on the {MESH_DEVICES}-wide "
+            f"mesh (each chip's share of the sharded fact work fits): "
+            + ", ".join(f"q{q}={verdicts[q]}" for q in bad)
+        )
+    if list(rejects) != list(EXPECTED_MESH_REJECTS):
+        failures += 1
+        print(
+            f"plan_budget_corpus: FAIL: per-device SF10 reject set "
+            f"{rejects} != pinned {list(EXPECTED_MESH_REJECTS)} — "
+            f"scale-out must admit everything except the known "
+            f"window-all-gather shape (a new reject is a model/plan "
+            f"regression; an admitted q47 means the dist-window rewrite "
+            f"landed and the pin should move)"
+        )
     return failures
 
 
