@@ -890,6 +890,10 @@ def bench_sf10(sess_sf1):
         write_detail()
         emit()
 
+    # one round-level trace context: every isolation child parents to it
+    from nds_tpu.obs.trace import resolve_trace_context
+
+    round_ctx = resolve_trace_context("sf10-round")
     remaining = list(names)
     while remaining:
         left = budget - (time.monotonic() - t_start)
@@ -903,6 +907,11 @@ def bench_sf10(sess_sf1):
         env["NDS_BENCH_SF10_WALL_BUDGET"] = str(int(left))
         if aot_dir:
             env["NDS_AOT_CACHE_DIR"] = aot_dir
+        # per-child trace context: the isolation child's event files (and
+        # any failure bundle it flushes before dying) carry a trace_id the
+        # parent minted — attribution survives pid recycling across the
+        # many children a long SF10 round respawns
+        round_ctx.child(f"sf10-{len(remaining)}left").export(env)
         stderr_tail = ""
         budget_kill = False
         try:

@@ -30,7 +30,11 @@ Every rule below encodes a bug this codebase actually shipped (and fixed):
                           must exist in obs/trace.py:EVENT_SCHEMA and pass
                           the kind's required fields (or forward **fields),
                           so schema drift breaks lint instead of the
-                          tolerant trace reader. Scope: everywhere. In
+                          tolerant trace reader. Context fields
+                          (trace_id — obs/trace.py:CONTEXT_FIELDS) are
+                          stamped centrally by Tracer.emit: a call site
+                          passing one explicitly must declare it in the
+                          kind's EVENT_SCHEMA entry. Scope: everywhere. In
                           obs/metrics.py the same rule also checks the
                           LIVE-metric taxonomy: every family in
                           METRIC_KINDS must map to a real EVENT_SCHEMA
@@ -51,6 +55,12 @@ Every rule below encodes a bug this codebase actually shipped (and fixed):
                           mentioned somewhere in code, so dead knob rows
                           can't accumulate in the docs. Same README-on-
                           disk skip as above.
+  debug-route-seam        the PR-12 single-listener invariant: /debug
+                          routes register on the ONE process-wide
+                          listener (obs/httpserv.py) or dispatch through
+                          its attach_app seam, and nothing else may
+                          construct an HTTP server. Scope: everywhere
+                          except obs/httpserv.py.
   cache-lock-discipline   the serve work (ROADMAP item 4) makes the
                           session caches (exec_cache, join_order_cache,
                           pallas_promotions, plan_cache) multi-tenant;
@@ -330,7 +340,7 @@ def _r_local_import(tree, relpath):
 
 @_rule("trace-event-schema", _scope_all)
 def _r_trace_event_schema(tree, relpath):
-    from ..obs.trace import EVENT_SCHEMA
+    from ..obs.trace import CONTEXT_FIELDS, EVENT_SCHEMA
 
     out = []
     for kind, kwargs, has_star, line in iter_emit_calls(tree):
@@ -348,8 +358,81 @@ def _r_trace_event_schema(tree, relpath):
                 f"trace event {kind!r} missing required field(s) "
                 f"{sorted(missing)} (EVENT_SCHEMA contract)"
             )))
+        # trace-context discipline: trace_id (and friends) are stamped
+        # centrally by Tracer.emit from the tracer's TraceContext; an
+        # emission site passing one ad hoc either aliases another run's
+        # trace or silently shadows the stamp — a kind that legitimately
+        # needs an explicit value must DECLARE the field in EVENT_SCHEMA
+        for ctx_field in CONTEXT_FIELDS:
+            if ctx_field in kwargs and ctx_field not in EVENT_SCHEMA[kind]:
+                out.append((line, (
+                    f"trace event {kind!r} passes {ctx_field!r} "
+                    f"explicitly but does not declare it in EVENT_SCHEMA; "
+                    f"context fields are stamped centrally by Tracer.emit "
+                    f"— declare the field or drop the kwarg"
+                )))
     if relpath == "obs/metrics.py":
         out.extend(_metric_name_findings(tree, EVENT_SCHEMA))
+    return out
+
+
+#: modules allowed to construct an HTTP listener / own /debug routes: the
+#: ONE process-wide endpoint (PR-12 invariant: no second listener)
+_LISTENER_MODULE = "obs/httpserv.py"
+
+_HTTP_SERVER_CTORS = ("HTTPServer", "ThreadingHTTPServer", "TCPServer")
+
+
+@_rule("debug-route-seam", _scope_all)
+def _r_debug_route_seam(tree, relpath):
+    """The PR-12 single-listener invariant, mechanized: /debug routes
+    register on the shared listener (obs/httpserv.py) — or dispatch
+    through its `attach_app` seam — and nothing outside it may construct
+    its own HTTP server. A second listener forks the diagnosis surface
+    (two ports, one of them unmonitored) and breaks the serve-mode
+    contract that ONE port carries the whole surface."""
+    # the listener itself, and this rule's own definition (its prefix
+    # literal + finding text), are the two legitimate homes of the string
+    if relpath in (_LISTENER_MODULE, "analysis/lint.py"):
+        return []
+    out = []
+    # collect docstring constants (module/class/function first-statement
+    # strings): route tables documented in prose must not trip the rule
+    doc_ids = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            body = getattr(node, "body", [])
+            if body and isinstance(body[0], ast.Expr) and isinstance(
+                body[0].value, ast.Constant
+            ) and isinstance(body[0].value.value, str):
+                doc_ids.add(id(body[0].value))
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and node.value.startswith("/debug")
+            and id(node) not in doc_ids
+        ):
+            out.append((node.lineno, (
+                f"/debug route {node.value!r} referenced outside "
+                f"{_LISTENER_MODULE}; debug routes register on the one "
+                f"process-wide listener (or dispatch via attach_app) — "
+                f"no second listener"
+            )))
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, (ast.Name, ast.Attribute))
+            and (
+                node.func.id if isinstance(node.func, ast.Name)
+                else node.func.attr
+            ) in _HTTP_SERVER_CTORS
+        ):
+            out.append((node.lineno, (
+                f"HTTP server constructed outside {_LISTENER_MODULE}; "
+                f"the process has ONE listener (obs/httpserv.py) — "
+                f"attach new surfaces through attach_app"
+            )))
     return out
 
 

@@ -4,6 +4,9 @@ event logs).
 
     python -m nds_tpu.cli.profile <events.jsonl | trace_dir>...
         [--top N] [--per_query] [--json] [--check]
+    python -m nds_tpu.cli.profile --critical-path <events | trace_dir>...
+        [--min_attributed 0.9] [--json]
+    python -m nds_tpu.cli.profile --check <failure-bundle-*.json>...
     python -m nds_tpu.cli.profile --compare OLD NEW
         [--ratio 1.25] [--min_ms 50] [--fail_on_regression]
         [--bench OLD_BENCH NEW_BENCH]
@@ -14,7 +17,17 @@ a throughput run's per-stream files profile together naturally) into
 per-query operator time/rows breakdowns, the top-N hottest operators
 across the run, and cache-hit/retry tallies; a (partially) compacted
 trace dir profiles transparently — raw segments and `compact-*.json`
-summary artifacts merge with identical summary semantics. `--compare`
+summary artifacts merge with identical summary semantics.
+`--critical-path` attributes each query's wall time to named causes
+(execute / exchange-wait / spill-io / catalog-load / ladder-retry /
+backoff-wait / hung-wait / plan-host — obs/critpath.py) and, on mesh
+traces, names the straggler device and the skew share of the exchange
+gap; `--min_attributed R` exits 1 when any query's attributed share
+falls below R (the CI diagnosis gate). Paths that look like flight-
+recorder failure bundles (`failure-bundle-*.json`) are validated
+structurally (bundle keys + ring event schema) instead of being parsed
+as event logs — `profile --check <bundle>` is how CI asserts a crash
+left a USABLE black box. `--compare`
 diffs two runs and flags per-query and per-operator regressions.
 `compact` folds closed rotation segments (engine.trace_rotate_bytes)
 into per-app summary artifacts and deletes the raw files, bounding a
@@ -28,6 +41,8 @@ import argparse
 import json
 import sys
 
+from ..obs import critpath as CP
+from ..obs import flight as FL
 from ..obs import reader as R
 
 
@@ -412,7 +427,17 @@ def main(argv=None):
                         help="emit the aggregate as JSON instead of text")
     parser.add_argument("--check", action="store_true",
                         help="exit 2 on any schema problem (CI gate); "
-                        "malformed JSON lines always exit 2")
+                        "malformed JSON lines always exit 2; failure-"
+                        "bundle paths are structurally validated")
+    parser.add_argument("--critical-path", "--critical_path",
+                        action="store_true", dest="critical_path",
+                        help="attribute per-query wall time to named "
+                        "causes (and name the mesh straggler device) "
+                        "instead of the operator breakdown")
+    parser.add_argument("--min_attributed", type=float, metavar="FRAC",
+                        help="with --critical-path: exit 1 when any "
+                        "query's attributed wall share is below FRAC "
+                        "(the CI diagnosis gate)")
     parser.add_argument("--min_exec_cache_hit_rate", type=float,
                         metavar="RATE",
                         help="exit 1 when the run's fused-executable cache "
@@ -460,6 +485,61 @@ def main(argv=None):
         return
     if not args.paths:
         parser.error("give event-log paths, or --compare OLD NEW")
+    # flight-recorder failure bundles validate structurally; they are not
+    # event logs and must not be parsed as one
+    bundles = [p for p in args.paths if FL.is_bundle_path(p)]
+    args.paths = [p for p in args.paths if not FL.is_bundle_path(p)]
+    bundle_problems = 0
+    for b in bundles:
+        try:
+            obj = FL.read_bundle(b)
+            problems = FL.validate_bundle(obj)
+        except (OSError, ValueError) as exc:
+            problems = [str(exc)]
+            obj = None
+        for p in problems[:20]:
+            print(f"profile: bundle {b}: {p}", file=sys.stderr)
+        bundle_problems += len(problems)
+        if obj is not None and not problems:
+            print(
+                f"== bundle {b}: reason {obj['reason']}, trace "
+                f"{obj['trace_id']}, query {obj.get('query')}, "
+                f"{len(obj['events'])} ring event(s)"
+            )
+    if bundle_problems and args.check:
+        sys.exit(2)
+    if not args.paths:
+        return  # bundle-only invocation
+    if args.critical_path:
+        # raw events only: compaction artifacts hold pre-aggregated
+        # profiles, not the spans the reconstruction needs
+        try:
+            events = R.read_events(args.paths, strict=True)
+        except (R.MalformedEventError, OSError) as exc:
+            print(f"profile: {exc}", file=sys.stderr)
+            sys.exit(2)
+        if args.check:
+            problems = R.validate_events(events)
+            if problems:
+                for p in problems[:20]:
+                    print(f"profile: schema: {p}", file=sys.stderr)
+                sys.exit(2)
+        cp = CP.critical_path(events)
+        if args.as_json:
+            print(json.dumps(cp, indent=2))
+        else:
+            CP.render(cp)
+        if args.min_attributed is not None:
+            worst = CP.min_attributed_frac(cp)
+            if worst is None or worst < args.min_attributed:
+                print(
+                    f"profile: critical-path attribution "
+                    f"{'absent' if worst is None else f'{worst:.1%}'} is "
+                    f"below the required {args.min_attributed:.1%}",
+                    file=sys.stderr,
+                )
+                sys.exit(1)
+        return
     prof = _load_profile(args.paths, args.check)
     if args.as_json:
         print(json.dumps(prof, indent=2))

@@ -703,12 +703,17 @@ class Executor:
                 pass
         return int(n_dev) * int(per_dev)
 
-    def _emit_exchange(self, op, n_dev, bytes_moved, counts, retries):
+    def _emit_exchange(self, op, n_dev, bytes_moved, counts, retries,
+                       dur_ms=None):
         """One `exchange` trace event per executed collective exchange:
         bytes moved over the interconnect (padded-capacity measure, both
         all_to_all passes), partition (device) count, the received-row
-        skew ratio (max device / mean; 1.0 = perfectly balanced), and how
-        many capacity-overflow retries the step burned."""
+        skew ratio (max device / mean; 1.0 = perfectly balanced), how
+        many capacity-overflow retries the step burned, the measured wall
+        of the whole exchange step (`dur_ms`, retries included — the
+        critical-path profiler's exchange-wait cause), and the per-device
+        received-row counts (`per_device` — what names the straggler
+        device)."""
         if self.tracer is None:
             return
         c = np.asarray(counts, dtype=np.float64)
@@ -720,6 +725,9 @@ class Executor:
             "exchange", op=op, partitions=int(n_dev),
             bytes_moved=int(bytes_moved), skew=round(skew, 3),
             retries=int(retries),
+            per_device=[int(x) for x in c],
+            **({"dur_ms": round(float(dur_ms), 3)}
+               if dur_ms is not None else {}),
         )
 
     def _try_dist_sort(self, child: Table, keys):
@@ -778,6 +786,7 @@ class Executor:
         local_rows = cap // n_dev
         cap_route = bucket_cap(max(1, 2 * local_rows // n_dev))
         retries = 0
+        ex_t0 = _perf()
         while True:
             fn = get_sample_sort(mesh, len(tkeys), len(payload), cap_route)
             out = fn(route, live, *tkeys, *payload)
@@ -796,7 +805,7 @@ class Executor:
         self._emit_exchange(
             "sort", n_dev,
             per_row * (n_dev * n_dev * cap_route + n_dev * cap),
-            out[-2], retries,
+            out[-2], retries, dur_ms=(_perf() - ex_t0) * 1000.0,
         )
         cols_out = out[1:1 + len(child.columns)]
         valids_out = list(out[1 + len(child.columns):-2])
@@ -1442,6 +1451,7 @@ class Executor:
         retries = 0
         rest = None
         used_l, used_r = cap_l, cap_r  # caps the LAST attempt shipped with
+        ex_t0 = _perf()
         for _attempt in range(self._EXCHANGE_MAX_ATTEMPTS):
             fn = get_exchange_hash_join(
                 mesh, len(lk), n_lc, n_rc, cap_l, cap_r, pair_cap, kind
@@ -1476,7 +1486,7 @@ class Executor:
                     "join", n_dev,
                     self._exchange_bytes(n_dev, used_l, used_r,
                                          lh, lk, l_ship, rh, rk, r_ship),
-                    rest[-2], retries,
+                    rest[-2], retries, dur_ms=(_perf() - ex_t0) * 1000.0,
                 )
             if str(session.conf.get("engine.spill", "auto")).lower() == "off":
                 return None  # out-of-core disabled: legacy sort-join fallback
@@ -1497,7 +1507,7 @@ class Executor:
             "join", n_dev,
             self._exchange_bytes(n_dev, used_l, used_r,
                                  lh, lk, l_ship, rh, rk, r_ship),
-            rest[-2], retries,
+            rest[-2], retries, dur_ms=(_perf() - ex_t0) * 1000.0,
         )
         l_out = rest[:n_lc]
         r_out = rest[n_lc:n_lc + n_rc]
@@ -3150,10 +3160,13 @@ class Executor:
         except (TypeError, ValueError):
             return 0
 
-    def _spill_finish(self, op, parts, pool, before, segments) -> Table:
+    def _spill_finish(self, op, parts, pool, before, segments,
+                      t0=None) -> Table:
         """Assemble a spilled op's segments into one device table, record
         the statement-level spill evidence (executor + session markers,
-        `spill` trace event) and release the segments."""
+        `spill` trace event — with the out-of-core step's measured wall
+        when the caller timed it, the critical-path spill-io cause) and
+        release the segments."""
         try:
             out = SP.assemble_segments(pool, segments)
         finally:
@@ -3179,6 +3192,8 @@ class Executor:
                 "spill", op=op, partitions=parts,
                 bytes_in=delta["bytes_in"], bytes_out=delta["bytes_out"],
                 evictions=delta["evictions"], rows=out.nrows_known,
+                **({"dur_ms": round((_perf() - t0) * 1000.0, 3)}
+                   if t0 is not None else {}),
             )
         return out
 
@@ -3196,6 +3211,7 @@ class Executor:
         session = self.catalog.session
         pool = session.spill_pool
         before = dict(pool.stats)
+        sp_t0 = _perf()
         lp = K.hash_columns(lk, lv) % parts
         rp = K.hash_columns(rk, rv) % parts
         segments = []
@@ -3214,7 +3230,8 @@ class Executor:
                 )
                 segments.append(pool.put(out))
                 session.spill_progress()
-            return self._spill_finish("join", parts, pool, before, segments)
+            return self._spill_finish("join", parts, pool, before, segments,
+                                      t0=sp_t0)
         except BaseException:
             pool.release(segments)
             raise
@@ -3235,6 +3252,7 @@ class Executor:
         session = self.catalog.session
         pool = session.spill_pool
         before = dict(pool.stats)
+        sp_t0 = _perf()
         nrows = child.nrows
         segments = []
         try:
@@ -3253,7 +3271,8 @@ class Executor:
                 }
                 segments.append(pool.put(Table(cols, n_w)))
                 session.spill_progress()
-            return self._spill_finish(op, parts, pool, before, segments)
+            return self._spill_finish(op, parts, pool, before, segments,
+                                      t0=sp_t0)
         except BaseException:
             pool.release(segments)
             raise
@@ -3271,6 +3290,7 @@ class Executor:
         session = self.catalog.session
         pool = session.spill_pool
         before = dict(pool.stats)
+        sp_t0 = _perf()
         live = t.row_mask()
         h = K.hash_columns(
             [c.data for c in t.columns.values()],
@@ -3287,7 +3307,7 @@ class Executor:
                 segments.append(pool.put(self._distinct_table(part)))
                 session.spill_progress()
             out = self._spill_finish("distinct", parts, pool, before,
-                                     segments)
+                                     segments, t0=sp_t0)
         except BaseException:
             pool.release(segments)
             raise

@@ -374,7 +374,13 @@ class Catalog:
             # exercises the transient-IO ladder rung end to end)
             faults.maybe_fire(f"load:{name}")
             faults.maybe_fire(name)
-        tracer = getattr(self.session, "tracer", None)
+        # the thread-bound tracer wins over the session's: a serve
+        # request's per-request forwarding tracer is bound around the
+        # execution (obs_trace.bind), so its catalog loads carry the
+        # request's trace_id/tenant instead of the shared session stream
+        from ..obs import trace as _obs_trace
+
+        tracer = _obs_trace.current() or getattr(self.session, "tracer", None)
         t0 = _perf() if tracer is not None else 0.0
         # capture THIS load's snapshot handle: a concurrent stream
         # re-pinning the shared entry must not swap the manifest (or the
@@ -1117,6 +1123,23 @@ class Session:
         if isinstance(stmt, A.SelectStmt):
             binder = Binder(self.catalog)
             plan = self._finish_plan(binder.bind(stmt), binder.promotions)
+            if self.tracer is not None:
+                # flight-recorder context: keep this statement's plan at
+                # hand so a failure bundle carries the FAILING query's
+                # plan, not a reconstruction. Noted as a LAZY thunk —
+                # P.explain renders only if a bundle actually flushes, so
+                # the serve hot path pays one lock + one lambda per
+                # statement, never a string render
+                from ..obs import flight as _obs_flight
+
+                rec = _obs_flight.recorder(self.conf)
+                if rec is not None:
+                    from .. import faults as _faults
+
+                    rec.note_plan(
+                        _faults.current_scope(),
+                        lambda p=plan: P.explain(p),
+                    )
             return Result(self, self._pin_lake_scans(plan))
         if isinstance(stmt, A.CreateViewStmt):
             binder = Binder(self.catalog)

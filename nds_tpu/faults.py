@@ -282,6 +282,34 @@ class FaultRegistry:
         if r.kind == "io":
             raise TransientIOError(f"injected transient io failure at {site!r}")
         if r.kind == "crash":
+            # simulated process death: flush the flight-recorder ring
+            # BEFORE raising, so the black box survives the crash the way
+            # a real crash handler would leave one (the fault_injected
+            # event above is already in the ring — parents classify the
+            # death from the bundle even with no trace dir configured)
+            try:
+                from .obs import flight as _obs_flight
+
+                # prefer the bound tracer's ring: it honors a conf-tier
+                # engine.flight_recorder=off for the session that is
+                # actually crashing; the module recorder is the fallback
+                # for session-less sites. (The bundle DIR resolves at the
+                # env tier here — this layer has no conf in hand; the
+                # report-side flushes pass the session conf through.)
+                tracer = _obs_trace.current()
+                if tracer is not None:
+                    rec = getattr(tracer, "ring", None)
+                else:
+                    rec = _obs_flight.recorder()
+                if rec is not None:
+                    ctx = getattr(tracer, "context", None)
+                    rec.flush(
+                        "crash",
+                        trace_id=getattr(ctx, "trace_id", None),
+                        query=current_scope(),
+                    )
+            except Exception:
+                pass  # forensics must never mask the injected death
             raise InjectedCrash(f"injected crash at {site!r}")
 
 

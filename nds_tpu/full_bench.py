@@ -421,11 +421,17 @@ def _bench_trace_conf(params):
         return None
 
 
-def _phase_failure_kind(exc, trace_dir, pre_existing) -> str:
+def _phase_failure_kind(exc, trace_dir, pre_existing, launch=None) -> str:
     """Classify a phase failure; when the exception itself is opaque (a
     subprocess CalledProcessError carries only the exit code) fall back to
     the event files the phase's child processes wrote before dying — the
-    "classify subprocess phase failures from their logs" ROADMAP gap."""
+    "classify subprocess phase failures from their logs" ROADMAP gap.
+
+    `launch` is the phase's launch record ({"trace_id", "ts_ms"}): a
+    candidate file's `trace_meta` must belong to THIS phase's context
+    (its trace_id, or a child parented to it) or at least postdate the
+    launch — a leftover file from an unrelated process (or a recycled
+    pid) no longer gets blamed for this phase's death."""
     kind = faults.classify(exc)
     if kind != faults.UNKNOWN or not trace_dir:
         return kind
@@ -434,6 +440,22 @@ def _phase_failure_kind(exc, trace_dir, pre_existing) -> str:
         for f in obs_reader.discover_event_files(trace_dir)
         if f not in pre_existing
     ]
+    if launch:
+        verified = []
+        for f in new:
+            meta = obs_reader.trace_meta_of(f)
+            if meta is None:
+                continue
+            tid = launch.get("trace_id")
+            if tid and meta.get("trace_id") is not None:
+                if meta["trace_id"] == tid or meta.get("parent") == tid:
+                    verified.append(f)
+                continue
+            if obs_reader.meta_matches_launch(
+                meta, launch_ts_ms=launch.get("ts_ms")
+            ):
+                verified.append(f)
+        new = verified
     if not new:
         return kind
     from_events = obs_reader.failure_kind_from_files(new)
@@ -466,6 +488,19 @@ def _run_phase(state: BenchState, name: str, skip, fn, tracer=None,
     delays = faults.backoff_delays(retries, base)
     if trace_dir is None:
         trace_dir = obs_trace.resolve_trace_dir()
+    # per-phase trace context: minted as a child of the orchestrator's and
+    # exported through the environment so every subprocess this phase
+    # spawns (power CLI, throughput parent -> its stream children, ...)
+    # adopts/parents to it — the failure classifier then verifies candidate
+    # event files against THIS launch record instead of trusting pids.
+    # Phases run sequentially, so the env mutation cannot race a sibling.
+    parent_ctx = (
+        getattr(tracer, "context", None)
+        or obs_trace.resolve_trace_context("full_bench")
+    )
+    phase_ctx = parent_ctx.child(name)
+    prev_env_ctx = os.environ.get(obs_trace.TRACE_CONTEXT_ENV)
+    os.environ[obs_trace.TRACE_CONTEXT_ENV] = phase_ctx.to_env_value()
     attempt = 0
     t0 = time.perf_counter()
     if tracer is not None:
@@ -477,33 +512,49 @@ def _run_phase(state: BenchState, name: str, skip, fn, tracer=None,
             "phase", phase=name, event="begin",
             **({"index": idx, "total": len(PHASES)} if idx else {}),
         )
-    while True:
-        attempt += 1
-        pre_existing = set(obs_reader.discover_event_files(trace_dir))
-        try:
-            faults.maybe_fire(name)
-            fn()
-            break
-        except Exception as exc:
-            kind = _phase_failure_kind(exc, trace_dir, pre_existing)
-            transient = kind in faults.RETRYABLE or (
-                kind == faults.UNKNOWN and retry_unknown
-            )
-            delay = next(delays, None) if transient else None
-            if delay is None:
-                if tracer is not None:
-                    tracer.emit(
-                        "phase", phase=name, event="end", status="failed",
-                        failure_kind=kind, attempts=attempt,
-                        dur_ms=round((time.perf_counter() - t0) * 1000, 3),
-                    )
-                raise PhaseError(name, kind, attempt, exc) from exc
-            print(
-                f"====== phase {name}: attempt {attempt} failed "
-                f"({kind}: {exc}); retrying in {delay:.1f}s ======",
-                flush=True,
-            )
-            time.sleep(delay)
+    try:
+        while True:
+            attempt += 1
+            launch = {
+                "trace_id": phase_ctx.trace_id,
+                "ts_ms": int(time.time() * 1000),
+            }
+            pre_existing = set(obs_reader.discover_event_files(trace_dir))
+            try:
+                faults.maybe_fire(name)
+                fn()
+                break
+            except Exception as exc:
+                kind = _phase_failure_kind(
+                    exc, trace_dir, pre_existing, launch=launch
+                )
+                transient = kind in faults.RETRYABLE or (
+                    kind == faults.UNKNOWN and retry_unknown
+                )
+                delay = next(delays, None) if transient else None
+                if delay is None:
+                    if tracer is not None:
+                        tracer.emit(
+                            "phase", phase=name, event="end", status="failed",
+                            failure_kind=kind, attempts=attempt,
+                            dur_ms=round(
+                                (time.perf_counter() - t0) * 1000, 3
+                            ),
+                        )
+                    raise PhaseError(name, kind, attempt, exc) from exc
+                print(
+                    f"====== phase {name}: attempt {attempt} failed "
+                    f"({kind}: {exc}); retrying in {delay:.1f}s ======",
+                    flush=True,
+                )
+                time.sleep(delay)
+    finally:
+        # restore the orchestrator-level context for the next phase (and
+        # for anything the caller spawns after us)
+        if prev_env_ctx is None:
+            os.environ.pop(obs_trace.TRACE_CONTEXT_ENV, None)
+        else:
+            os.environ[obs_trace.TRACE_CONTEXT_ENV] = prev_env_ctx
     if tracer is not None:
         tracer.emit(
             "phase", phase=name, event="end", status="ok", attempts=attempt,

@@ -12,6 +12,12 @@ heartbeat liveness beacon.
 `reader` — event-log parsing, validation, segment-chain reassembly,
 fold-in summaries, operator aggregation, trace-dir compaction, and A/B
 comparison (backing `nds_tpu/cli/profile.py`).
+`flight` — the always-on flight recorder: a process-wide bounded event
+ring every Tracer feeds, flushed as self-contained failure bundles on
+watchdog fire / ladder exhaustion / crash / `/debug/flight`.
+`critpath` — critical-path reconstruction: per-query wall attributed to
+named causes (exchange-wait/skew, spill-io, ladder retries, ...) with
+mesh straggler naming (backing `profile --critical-path`).
 """
 
 from .trace import (  # noqa: F401

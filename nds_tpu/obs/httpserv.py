@@ -10,10 +10,25 @@ back from `MetricsServer.port`).
     GET /metrics   Prometheus text exposition of the registry
     GET /statusz   JSON run status: current phase, in-flight query with
                    elapsed/attempt/ladder, completed/failed counts, cache
-                   hit rates, RSS + memory high-water, heartbeat age (and
-                   per-tenant serve stats when serve mode is attached)
+                   hit rates, RSS + memory high-water, heartbeat age, a
+                   `mesh` section (per-device HBM high-water + last
+                   exchange skew/bytes) and per-tenant serve stats when
+                   serve mode is attached
     GET /healthz   "ok" liveness; 503 "draining" once a serve-mode drain
                    begins, so load balancers stop routing BEFORE shutdown
+    GET /debug/flight    the flight recorder's current bundle (last-N
+                   events + plan/budget/ladder/memory/conf context) as
+                   JSON; `?write=1` also persists it as a
+                   failure-bundle-<trace_id>.json (obs/flight.py)
+    GET /debug/jaxprof   on-demand jax.profiler status; POST with
+                   {"action": "start"|"stop", "dir": ...} starts/stops a
+                   profiler trace on the LIVE process (the "why is this
+                   serve worker slow right now" tool)
+
+Debug-route invariant (lint `debug-route-seam`): every /debug route
+registers HERE, on the one process-wide listener — never on a second
+listener, and serve-mode apps reach theirs through `attach_app` exactly
+like the query routes.
 
 Serve mode (`nds_tpu/serve/`) attaches an application via `attach_app`:
 any route the built-ins above don't own is dispatched to
@@ -38,6 +53,63 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 #: largest accepted POST body (a query request is SQL text + a small JSON
 #: envelope; anything bigger is a client bug or a flood)
 MAX_BODY_BYTES = 8 << 20
+
+#: on-demand jax.profiler state (one profiler per process — jax itself
+#: enforces that); guarded by its lock because two /debug/jaxprof POSTs
+#: may race on the threading server
+_JAXPROF_LOCK = threading.Lock()
+_JAXPROF = {"dir": None, "started_ts_ms": None}
+
+
+def _jaxprof_status() -> dict:
+    with _JAXPROF_LOCK:
+        return {
+            "running": _JAXPROF["dir"] is not None,
+            "dir": _JAXPROF["dir"],
+            "started_ts_ms": _JAXPROF["started_ts_ms"],
+        }
+
+
+def _jaxprof_action(payload: dict) -> tuple:
+    """(status_code, body_dict) for a /debug/jaxprof POST."""
+    import time
+
+    action = str(payload.get("action") or "").lower()
+    if action not in ("start", "stop"):
+        return 400, {"error": "action must be 'start' or 'stop'"}
+    try:
+        import jax
+    except Exception as exc:  # pragma: no cover - jax is a hard dep
+        return 500, {"error": f"jax unavailable: {type(exc).__name__}"}
+    with _JAXPROF_LOCK:
+        if action == "start":
+            if _JAXPROF["dir"] is not None:
+                return 409, {
+                    "error": "profiler already running",
+                    "dir": _JAXPROF["dir"],
+                }
+            from .flight import resolve_flight_dir
+
+            d = payload.get("dir") or os.path.join(
+                resolve_flight_dir(), f"jaxprof-{int(time.time())}"
+            )
+            try:
+                jax.profiler.start_trace(str(d))
+            except Exception as exc:
+                return 500, {"error": f"start_trace: {exc}"}
+            _JAXPROF["dir"] = str(d)
+            _JAXPROF["started_ts_ms"] = int(time.time() * 1000)
+            return 200, {"running": True, "dir": str(d)}
+        if _JAXPROF["dir"] is None:
+            return 409, {"error": "profiler not running"}
+        d = _JAXPROF["dir"]
+        try:
+            jax.profiler.stop_trace()
+        except Exception as exc:
+            return 500, {"error": f"stop_trace: {exc}"}
+        _JAXPROF["dir"] = None
+        _JAXPROF["started_ts_ms"] = None
+        return 200, {"running": False, "dir": d}
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -98,6 +170,12 @@ class _Handler(BaseHTTPRequestHandler):
                     )
                 else:
                     self._reply(200, "ok\n", "text/plain; charset=utf-8")
+            elif path == "/debug/flight":
+                self._debug_flight()
+            elif path == "/debug/jaxprof":
+                self._reply(
+                    200, json.dumps(_jaxprof_status()), "application/json"
+                )
             elif not self._dispatch_app("GET", path, None):
                 self._reply(404, "not found\n", "text/plain; charset=utf-8")
         except BrokenPipeError:
@@ -121,6 +199,20 @@ class _Handler(BaseHTTPRequestHandler):
                 )
                 return
             body = self.rfile.read(length) if length else b""
+            if path == "/debug/jaxprof":
+                try:
+                    payload = json.loads(body.decode("utf-8")) if body else {}
+                except (ValueError, UnicodeDecodeError) as exc:
+                    self._reply(
+                        400, json.dumps({"error": str(exc)}),
+                        "application/json",
+                    )
+                    return
+                code, obj = _jaxprof_action(
+                    payload if isinstance(payload, dict) else {}
+                )
+                self._reply(code, json.dumps(obj), "application/json")
+                return
             try:
                 handled = self._dispatch_app("POST", path, body)
             except ValueError as exc:  # malformed JSON body
@@ -136,6 +228,30 @@ class _Handler(BaseHTTPRequestHandler):
             pass
         except Exception as exc:  # app bug: a JSON 500, not a socket reset
             self._internal_error(exc)
+
+    def _debug_flight(self):
+        """GET /debug/flight: the current flight-recorder bundle, built on
+        demand from the live ring. `?write=1` also persists it (the
+        "grab me a black box from the live service" verb)."""
+        from . import flight as obs_flight
+
+        rec = obs_flight.recorder()
+        if rec is None:
+            self._reply(
+                503,
+                json.dumps({"error": "flight recorder disabled "
+                            "(NDS_FLIGHT_RECORDER=off)"}),
+                "application/json",
+            )
+            return
+        from urllib.parse import parse_qs
+
+        query = self.path.split("?", 1)
+        params = parse_qs(query[1]) if len(query) > 1 else {}
+        bundle = rec.bundle("on_demand")
+        if params.get("write", ["0"])[-1] == "1":
+            bundle["written"] = rec.flush("on_demand")
+        self._reply(200, json.dumps(bundle, default=str), "application/json")
 
     def _internal_error(self, exc):
         """An exception escaping the attached app must still answer the

@@ -27,22 +27,33 @@ import time
 _PAGE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
 
 
-def device_bytes_in_use():
-    """Total bytes_in_use over local jax devices, or None when the backend
-    exposes no memory stats (CPU), or jax isn't importable here."""
+def device_bytes_per_device():
+    """Per-device `bytes_in_use` over local jax devices as a list (index
+    = local device ordinal), or None when the backend exposes no memory
+    stats (CPU), or jax isn't importable here. Kept PER DEVICE on
+    purpose: the /statusz `mesh` section and failure bundles want the
+    straggler device visible, not one aggregated max."""
     try:
         import jax
 
-        total = 0
+        out = []
         seen = False
         for d in jax.local_devices():
             stats = d.memory_stats()
-            if stats and stats.get("bytes_in_use") is not None:
-                total += int(stats["bytes_in_use"])
+            v = stats.get("bytes_in_use") if stats else None
+            out.append(int(v) if v is not None else 0)
+            if v is not None:
                 seen = True
-        return total if seen else None
+        return out if seen else None
     except Exception:
         return None
+
+
+def device_bytes_in_use():
+    """Total bytes_in_use over local jax devices, or None when the backend
+    exposes no memory stats (CPU), or jax isn't importable here."""
+    per = device_bytes_per_device()
+    return sum(per) if per is not None else None
 
 
 def rss_bytes():
@@ -77,6 +88,10 @@ class MemorySampler:
             )
         self.interval_s = max(interval_s, 0.001)
         self.peak_bytes = None
+        #: per-device high-water (list, device-source runs only): the
+        #: straggler-visible half of the peak — query_span carries it as
+        #: `mem_hw_per_device` and /statusz's mesh section max-merges it
+        self.peak_per_device = None
         self.source = None
         self.watermark_bytes = watermark_bytes or None
         self.on_watermark = on_watermark
@@ -105,7 +120,22 @@ class MemorySampler:
             self._read = None
 
     def _sample(self):
-        v = self._read() if self._read is not None else None
+        per_dev = None
+        if self.source == "device":
+            per_dev = device_bytes_per_device()
+            v = sum(per_dev) if per_dev is not None else None
+            if per_dev is not None:
+                if self.peak_per_device is None:
+                    self.peak_per_device = list(per_dev)
+                else:
+                    for i, b in enumerate(per_dev):
+                        if i < len(self.peak_per_device):
+                            if b > self.peak_per_device[i]:
+                                self.peak_per_device[i] = b
+                        else:
+                            self.peak_per_device.append(b)
+        else:
+            v = self._read() if self._read is not None else None
         if v is not None and (self.peak_bytes is None or v > self.peak_bytes):
             self.peak_bytes = v
         if (
@@ -131,6 +161,11 @@ class MemorySampler:
                         query=self.query,
                         elapsed_ms=round((now - self._t0) * 1000, 1),
                         rss_bytes=r,
+                        # per-device HBM rides the beacon so the live
+                        # /statusz mesh section tracks each device's
+                        # high-water, not one aggregated max
+                        **({"dev_bytes": list(per_dev)}
+                           if per_dev is not None else {}),
                     )
                 except Exception:
                     pass  # the beacon must never take the query down

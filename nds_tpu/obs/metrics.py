@@ -394,6 +394,8 @@ class MetricsSink:
                 if int(ev["mem_hw_bytes"]) > cur:
                     self._status["mem_hw_bytes"] = int(ev["mem_hw_bytes"])
                     self._status["mem_source"] = ev.get("mem_source")
+            if isinstance(ev.get("mem_hw_per_device"), list):
+                self._merge_device_hw_locked(ev["mem_hw_per_device"])
 
     def _h_plan_cache(self, ev):
         self.registry.inc(
@@ -451,6 +453,31 @@ class MetricsSink:
             )
         except (TypeError, ValueError):
             pass
+        with self._slock:
+            mesh = self._status.setdefault("mesh", {})
+            mesh["last_exchange"] = {
+                "op": ev.get("op"),
+                "partitions": ev.get("partitions"),
+                "bytes_moved": ev.get("bytes_moved"),
+                "skew": ev.get("skew"),
+                "retries": ev.get("retries"),
+                "ts": ev.get("ts"),
+                **({"per_device": list(ev["per_device"])}
+                   if isinstance(ev.get("per_device"), list) else {}),
+            }
+
+    def _merge_device_hw_locked(self, per_dev):
+        """Element-wise max-merge per-device HBM samples into the mesh
+        section (caller holds _slock)."""
+        mesh = self._status.setdefault("mesh", {})
+        hw = mesh.setdefault("device_mem_hw", [])
+        for i, b in enumerate(per_dev):
+            b = int(b)
+            if i < len(hw):
+                if b > hw[i]:
+                    hw[i] = b
+            else:
+                hw.append(b)
 
     def _h_mesh_fallback(self, ev):
         self.registry.inc(
@@ -616,6 +643,8 @@ class MetricsSink:
             self._status["heartbeat_ts_ms"] = ev.get("ts")
             if ev.get("rss_bytes") is not None:
                 self._status["rss_bytes"] = int(ev["rss_bytes"])
+            if isinstance(ev.get("dev_bytes"), list):
+                self._merge_device_hw_locked(ev["dev_bytes"])
             rec = self._in_flight.get(self._flight_key(ev))
             if rec is not None:
                 rec["heartbeat_elapsed_ms"] = ev.get("elapsed_ms")
@@ -642,6 +671,15 @@ class MetricsSink:
                 k: (dict(v) if isinstance(v, dict) else v)
                 for k, v in self._status.items()
             }
+            if "mesh" in st:
+                # deep-copy: the live list/dict keep mutating under this
+                # lock after the snapshot escapes it
+                mesh = self._status["mesh"]
+                st["mesh"] = {
+                    k: (list(v) if isinstance(v, list)
+                        else dict(v) if isinstance(v, dict) else v)
+                    for k, v in mesh.items()
+                }
             if "tenants" in st:
                 # deep-copy + derive per-tenant cache hit rates (the
                 # shallow copy above would alias the live tallies)

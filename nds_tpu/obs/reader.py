@@ -95,6 +95,48 @@ def iter_events(path, strict: bool = True):
             pass  # torn final line: tolerated evidence of a crash
 
 
+def trace_meta_of(path):
+    """The first `trace_meta` event of one event file (tolerant: None on
+    an unreadable/torn/foreign file). The fold-in attribution key: every
+    segment opens with its producing process's meta line carrying pid,
+    emission epoch (`ts`) and — since the trace-context work — the
+    `trace_id` the launcher minted for that process."""
+    try:
+        for ev in iter_events(path, strict=False):
+            if ev.get("kind") == "trace_meta":
+                return ev
+            return None  # contract: meta is the FIRST line
+    except OSError:
+        return None
+    return None
+
+
+#: slack (ms) for launch-time matching: the child stamps its meta after
+#: interpreter start, but clocks may disagree slightly across a remote fs
+LAUNCH_TS_SLACK_MS = 5000
+
+
+def meta_matches_launch(meta, pid=None, launch_ts_ms=None,
+                        trace_id=None) -> bool:
+    """Does one event file's trace_meta belong to the child a launcher
+    recorded? The minted trace_id is authoritative when both sides carry
+    one (immune to pid recycling); otherwise fall back to pid PLUS an
+    emission-time check against the launch record — a recycled pid's
+    leftover file from a long-dead child predates this launch and is
+    rejected instead of mis-blamed."""
+    if meta is None:
+        return False
+    if trace_id is not None and meta.get("trace_id") is not None:
+        return meta["trace_id"] == trace_id
+    if pid is not None and meta.get("pid") != pid:
+        return False
+    if launch_ts_ms is not None:
+        ts = meta.get("ts")
+        if ts is None or int(ts) < int(launch_ts_ms) - LAUNCH_TS_SLACK_MS:
+            return False
+    return pid is not None or launch_ts_ms is not None
+
+
 def read_events(paths, strict: bool = True) -> list:
     """Events from one path or a list of paths (files or trace dirs),
     concatenated in file order."""

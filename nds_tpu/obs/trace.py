@@ -13,9 +13,24 @@ reference's profiling tool over Spark event logs). The LIVE half is
 `obs/metrics.py`: an optional MetricsSink on the same emit seam feeds the
 `/metrics` + `/statusz` endpoint while the run is still going.
 
-Zero-cost contract: with no trace dir configured, `tracer_from_conf` returns
-None, `Session.tracer` is None, and every instrumentation point in the hot
-path is a single attribute-load + `is None` check.
+Near-zero-cost contract (amended by the flight recorder): with no trace
+dir and no metrics port configured, `tracer_from_conf` now returns a
+RING-ONLY tracer — events are built and appended to the process-wide
+flight-recorder ring (obs/flight.py: one bounded deque append, no file,
+no in-memory list) so a crash or hang ALWAYS leaves a failure bundle
+behind, trace dir or not. Setting `engine.flight_recorder` /
+NDS_FLIGHT_RECORDER to off restores the historical contract
+(`tracer_from_conf` -> None, every instrumentation point one `is None`
+check). The ring's per-event cost is budgeted in CI (<2% of SF0.01
+stream wall — the tier1 diagnosis gate).
+
+Trace context: every tracer carries a `TraceContext` (trace_id + parent)
+and `emit` stamps `trace_id` on every event. Entry points mint one
+(power/throughput/full_bench/serve request/DM function — via
+`tracer_from_conf`, or explicitly); subprocess launchers export it as
+NDS_TRACE_CONTEXT so a child process ADOPTS the exact context its parent
+minted for it, and child event files fold by trace_id instead of the
+pid-recycling-prone pid match.
 
 Crash-safety contract: each event is written with ONE `write()` call of a
 complete line and flushed, so a reader never sees an interleaved line from
@@ -24,9 +39,12 @@ readers tolerate; any earlier malformed line is a hard error —
 `obs.reader.iter_events`).
 
 Event taxonomy (golden schema — tests/test_obs.py asserts it):
-every event carries `ts` (epoch ms), `kind`, `app`, and (when a query scope
+every event carries `ts` (epoch ms), `kind`, `app`, the stamped
+CONTEXT_FIELDS (`trace_id`; see TraceContext), and (when a query scope
 is active, `faults.scope`) `query`; per-kind required fields are listed in
-EVENT_SCHEMA below.
+EVENT_SCHEMA below. `trace_id` is stamped centrally by `Tracer.emit` —
+emission sites must NOT pass it ad hoc unless the kind declares it in
+EVENT_SCHEMA (the `trace-event-schema` lint rule enforces this).
 """
 
 from __future__ import annotations
@@ -81,7 +99,9 @@ EVENT_SCHEMA = {
     "aot_cache": ("op", "result"),
     # a fault-injection rule fired (faults.FaultRegistry)
     "fault_injected": ("site", "fault_kind"),
-    # one degradation-ladder rung taken (BenchReport)
+    # one degradation-ladder rung taken (BenchReport). Optional:
+    # attempt_ms (the FAILED attempt's wall this rung recovers from —
+    # the critical-path ladder-retry cause), delay_s (backoff rungs)
     "ladder_rung": ("query", "rung", "failure_kind"),
     # the per-query watchdog abandoned a hung attempt
     "watchdog_fire": ("query", "budget_s"),
@@ -107,7 +127,10 @@ EVENT_SCHEMA = {
     # samplesort): interconnect bytes moved (padded-capacity measure over
     # both all_to_all passes), partition (device) count, the received-row
     # skew ratio (max device / mean; 1.0 = perfectly balanced), and how
-    # many capacity-overflow retries the step burned before it fit
+    # many capacity-overflow retries the step burned before it fit.
+    # Optional: dur_ms (measured wall of the whole exchange step, retries
+    # included — the critical-path exchange-wait cause) and per_device
+    # (received-row counts per device — what names the straggler)
     "exchange": ("op", "partitions", "bytes_moved", "skew", "retries"),
     # a fact table could not row-shard over the session mesh (capacity not
     # divisible by the device count) and fell back to full replication
@@ -142,8 +165,17 @@ EVENT_SCHEMA = {
     # a hung query keeps heartbeating, so the hang is visible live on
     # /statusz (heartbeat age + in-flight elapsed) and classifiable
     # post-hoc from the log tail. Interval: NDS_HEARTBEAT_INTERVAL_MS.
+    # Optional: dev_bytes (per-device HBM sample list, device-source
+    # runs — feeds the /statusz mesh section's high-water)
     "heartbeat": ("query", "elapsed_ms", "rss_bytes"),
 }
+
+#: fields `Tracer.emit` stamps on EVERY event from the tracer's
+#: TraceContext (alongside ts/kind/app). Readers treat them as optional
+#: (pre-context logs lack them); call sites never pass them explicitly —
+#: the `trace-event-schema` lint flags an explicit `trace_id=` kwarg on a
+#: kind that does not declare it in EVENT_SCHEMA.
+CONTEXT_FIELDS = ("trace_id",)
 
 #: kinds kept in EVENT_SCHEMA for old-log readers but no longer emitted by
 #: the current tree; the golden-sync test (tests/test_analysis.py) requires
@@ -199,6 +231,81 @@ def default_app_id() -> str:
     return f"nds-tpu-{os.getpid()}-{int(time.time())}-{uuid.uuid4().hex[:6]}"
 
 
+#: env var carrying a parent-minted trace context into a child process
+TRACE_CONTEXT_ENV = "NDS_TRACE_CONTEXT"
+
+
+class TraceContext:
+    """Cross-process trace correlation: a `trace_id` (the whole-run or
+    per-request correlation key `Tracer.emit` stamps on every event) plus
+    the minting parent's trace_id.
+
+    Propagation contract: a LAUNCHER mints one context per child
+    (`ctx.child()`) and exports it (`ctx.export(env)`); the child's
+    `tracer_from_conf` finds NDS_TRACE_CONTEXT and adopts the context
+    VERBATIM — so the parent knows the exact trace_id the child's event
+    files carry and folds them by trace_id, immune to pid recycling. A
+    process with nothing in the environment mints a fresh root context."""
+
+    __slots__ = ("trace_id", "parent")
+
+    def __init__(self, trace_id: str, parent: str | None = None):
+        self.trace_id = str(trace_id)
+        self.parent = str(parent) if parent else None
+
+    def __repr__(self):
+        return f"TraceContext({self.trace_id!r}, parent={self.parent!r})"
+
+    @classmethod
+    def mint(cls, entry: str = "nds", parent: str | None = None):
+        """A fresh context for an entry point (power, throughput,
+        full_bench, a serve request, a DM function...)."""
+        return cls(f"{entry}-{uuid.uuid4().hex[:16]}", parent=parent)
+
+    def child(self, entry: str = "child") -> "TraceContext":
+        """A context for a subprocess this process launches: fresh
+        trace_id, parented to this one."""
+        return TraceContext.mint(entry, parent=self.trace_id)
+
+    # -- env carriage ----------------------------------------------------
+    def to_env_value(self) -> str:
+        return (
+            f"{self.trace_id},{self.parent}" if self.parent
+            else self.trace_id
+        )
+
+    @classmethod
+    def from_env_value(cls, value: str):
+        value = str(value).strip()
+        if not value:
+            return None
+        bits = value.split(",", 1)
+        return cls(bits[0], parent=bits[1] if len(bits) > 1 else None)
+
+    def export(self, env: dict) -> dict:
+        """Write this context into a subprocess environment dict (and
+        return it, for call-site chaining)."""
+        env[TRACE_CONTEXT_ENV] = self.to_env_value()
+        return env
+
+
+def resolve_trace_context(entry: str = "proc") -> TraceContext:
+    """The process's trace context: adopt a parent-exported
+    NDS_TRACE_CONTEXT verbatim, else mint a fresh root for `entry`."""
+    ctx = TraceContext.from_env_value(
+        os.environ.get(TRACE_CONTEXT_ENV, "")
+    )
+    return ctx if ctx is not None else TraceContext.mint(entry)
+
+
+def current_context() -> TraceContext | None:
+    """The thread-bound tracer's context (None unbound) — launchers that
+    want to parent a child context to the running stream's reach it
+    here."""
+    t = current()
+    return getattr(t, "context", None) if t is not None else None
+
+
 class Tracer:
     """Append-only JSON-lines event writer (or an in-memory collector when
     `trace_dir` is None — the dev-tool mode tools/trace_query.py uses; or
@@ -222,7 +329,7 @@ class Tracer:
 
     def __init__(self, trace_dir: str | None = None, app_id: str | None = None,
                  kernel_spans: bool = False, sink=None, rotate_bytes: int = 0,
-                 collect: bool | None = None):
+                 collect: bool | None = None, context=None, ring=None):
         self.app_id = app_id or default_app_id()
         self.trace_dir = trace_dir
         # opt-in per-kernel dispatch timing: the ops.kernels instrumentation
@@ -231,6 +338,19 @@ class Tracer:
         # live-telemetry bridge (obs/metrics.py): every emitted event also
         # updates the sink's counters/status; None = no live metrics
         self.sink = sink
+        # cross-process correlation: every emitted event is stamped with
+        # this context's trace_id (adopted from NDS_TRACE_CONTEXT when a
+        # launcher minted one for this process, else freshly minted)
+        self.context = context or resolve_trace_context("tracer")
+        # flight-recorder ring (obs/flight.py): every emitted event also
+        # lands in the process-wide bounded ring so a failure bundle has
+        # the last-N events even when nothing else is configured. Ring
+        # append is one GIL-atomic deque op — emitters never block.
+        if ring is None:
+            from . import flight as obs_flight
+
+            ring = obs_flight.recorder()
+        self.ring = ring or None
         self.rotate_bytes = max(int(rotate_bytes or 0), 0)
         self.seq = 0
         self.path = self._segment_path(0) if trace_dir else None
@@ -248,8 +368,14 @@ class Tracer:
         if trace_dir:
             # eager meta line: the file exists (and is discoverable by a
             # parent/orchestrator) even if the process dies before its
-            # first real event
-            self.emit("trace_meta", pid=os.getpid(), version=__version__)
+            # first real event. Carries the trace context (trace_id via
+            # the central stamp, parent explicitly) so fold-in can match
+            # this file to its LAUNCH RECORD instead of trusting the pid.
+            self.emit(
+                "trace_meta", pid=os.getpid(), version=__version__,
+                **({"parent": self.context.parent}
+                   if self.context.parent else {}),
+            )
 
     def _segment_path(self, seq: int) -> str:
         if seq == 0:
@@ -279,19 +405,25 @@ class Tracer:
                         f"(close tracers only after their last emitter)"
                     )
             return
-        ev = {"ts": int(time.time() * 1000), "kind": kind, "app": self.app_id}
+        ev = {
+            "ts": int(time.time() * 1000), "kind": kind, "app": self.app_id,
+            "trace_id": self.context.trace_id,
+        }
         if "query" not in fields:
             scope = faults.current_scope()
             if scope is not None:
                 ev["query"] = scope
-        ev.update(fields)
+        ev.update(fields)  # an explicit trace_id (serve's per-request
+        # forwarding tracer) overrides the stamped context here
         if self.sink is not None:
             try:
                 self.sink.record(ev)
             except Exception:
                 pass  # live telemetry must never take the benchmark down
+        if self.ring is not None:
+            self.ring.record(ev)  # one bounded deque append; never blocks
         if self.path is None and self.events is None:
-            return  # sink-only mode: nothing to persist
+            return  # sink-only / ring-only mode: nothing to persist
         # serialize outside the lock (sink-only mode skipped it above)
         line = json.dumps(ev, default=str) if self.path is not None else None
         with self._lock:
@@ -336,8 +468,11 @@ class Tracer:
         self._fh = open(self.path, "a", encoding="utf-8")
         meta = json.dumps({
             "ts": int(time.time() * 1000), "kind": "trace_meta",
-            "app": self.app_id, "pid": os.getpid(),
+            "app": self.app_id, "trace_id": self.context.trace_id,
+            "pid": os.getpid(),
             "version": __version__, "seq": self.seq,
+            **({"parent": self.context.parent}
+               if self.context.parent else {}),
         })
         self._fh.write(meta + "\n")
         self._fh.flush()
@@ -353,29 +488,42 @@ class Tracer:
                 self._fh = None
 
 
-def tracer_from_conf(conf: dict | None = None, app_id: str | None = None):
-    """A Tracer when observability is configured, else None (the zero-cost
-    disabled state every instrumentation point checks for).
+def tracer_from_conf(conf: dict | None = None, app_id: str | None = None,
+                     context: TraceContext | None = None):
+    """A Tracer for the configured observability shape.
 
-    Three live shapes: a trace dir alone gives the classic file tracer; a
+    Four live shapes: a trace dir gives the classic file tracer; a
     metrics port alone gives a SINK-ONLY tracer (no file, no in-memory
     list — emission sites fire so the live registry stays hot, nothing is
-    persisted); both give a file tracer that also feeds the sink."""
+    persisted); both give a file tracer that also feeds the sink; and
+    with NEITHER configured the flight recorder keeps a RING-ONLY tracer
+    (events feed the process-wide bounded ring so failures always leave a
+    bundle). Only `engine.flight_recorder: off` / NDS_FLIGHT_RECORDER=off
+    returns None — the historical fully-disabled zero-cost state.
+
+    `context`: an explicit TraceContext for this tracer; default adopts
+    NDS_TRACE_CONTEXT (a launcher minted one for this process) or mints a
+    fresh root."""
     d = resolve_trace_dir(conf)
     # lazy: obs.metrics imports EVENT_SCHEMA from this module
+    from . import flight as obs_flight
     from . import metrics as obs_metrics
 
     sink = obs_metrics.maybe_serve(conf)
+    ring = obs_flight.recorder(conf)
+    if context is None:
+        context = resolve_trace_context("session")
     if not d:
-        if sink is None:
+        if sink is None and ring is None:
             return None
         return Tracer(
             None, app_id=app_id, kernel_spans=resolve_kernel_trace(conf),
-            sink=sink, collect=False,
+            sink=sink, collect=False, context=context, ring=ring or False,
         )
     return Tracer(
         d, app_id=app_id, kernel_spans=resolve_kernel_trace(conf),
         sink=sink, rotate_bytes=resolve_rotate_bytes(conf),
+        context=context, ring=ring or False,
     )
 
 

@@ -98,6 +98,25 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None):
         pass
 
 
+def worker_env(process_id=None, base: dict | None = None) -> dict:
+    """Subprocess environment for a spawned multihost worker: a copy of
+    this process's env (or `base`) carrying a per-worker trace context
+    (NDS_TRACE_CONTEXT) minted as a child of the launcher's — the
+    worker's event files then fold by trace_id, the same pid-proof
+    attribution the throughput parent uses for its stream children."""
+    from ..obs import trace as obs_trace
+
+    env = dict(os.environ if base is None else base)
+    ctx = obs_trace.current_context() or obs_trace.resolve_trace_context(
+        "multihost"
+    )
+    entry = (
+        f"worker{process_id}" if process_id is not None else "worker"
+    )
+    ctx.child(entry).export(env)
+    return env
+
+
 def global_mesh(axis: str = "data"):
     """Mesh over the global device set (all processes). On one host this is
     exactly dist.make_mesh(); on a pod it spans every chip of every host."""

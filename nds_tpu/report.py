@@ -322,6 +322,51 @@ class BenchReport:
             {"request_id": self._request_id} if self._request_id else {}
         )
 
+    def _trace_id(self):
+        """The trace_id a failure bundle files under: the serve request id
+        (the request IS the trace in serve mode), else the tracer's
+        stamped context."""
+        if self._request_id:
+            return self._request_id
+        ctx = getattr(self.tracer, "context", None)
+        return getattr(ctx, "trace_id", None)
+
+    def _flight_flush(self, reason: str, rungs, sampler=None):
+        """Flush the process flight ring as a failure bundle for THIS
+        query's incident (watchdog fire / ladder exhaustion / terminal
+        failure). Best-effort by contract: forensics must never take the
+        stream down, and a disabled recorder is a no-op."""
+        from .obs import flight as obs_flight
+        from .obs.memwatch import device_bytes_per_device, rss_bytes
+
+        rec = obs_flight.recorder()
+        if rec is None:
+            return
+        conf = getattr(self.session, "conf", {}) or {}
+        budget = (
+            self._plan_budget_override
+            if self._plan_budget_override is not None
+            else getattr(self.session, "last_plan_budget", None)
+        )
+        per_dev = device_bytes_per_device()
+        memory = {
+            "rss_bytes": rss_bytes(),
+            "device_bytes_per_device": per_dev,
+            "mem_hw_bytes": getattr(sampler, "peak_bytes", None),
+            "mem_hw_per_device": getattr(sampler, "peak_per_device", None),
+            "mem_source": getattr(sampler, "source", None),
+        }
+        rec.flush(
+            reason,
+            trace_id=self._trace_id(),
+            query=self._name,
+            budget=budget if isinstance(budget, dict) else None,
+            ladder=list(rungs) if rungs else None,
+            memory=memory,
+            conf=conf,
+            out_dir=obs_flight.resolve_flight_dir(conf),
+        )
+
     def _next_rung(self, kind: str, rungs_taken, can_retry: bool):
         """The next recovery rung for a failure of `kind`, or None.
 
@@ -531,10 +576,12 @@ class BenchReport:
         start_mono = time.perf_counter()
         rungs: list[dict] = []
         attempt_errors: list[str] = []
-        # memory high-water sampling rides with tracing (observability is
-        # opt-in; an untraced run pays no sampler thread) OR with a
+        # memory high-water sampling rides with tracing OR with a
         # configured host-RSS watermark (pre-emption needs the samples
-        # even when nothing is traced)
+        # even when nothing is traced). Since the flight recorder, the
+        # default tracer is ring-only rather than None, so the sampler
+        # (and its heartbeat beacon — hang evidence for failure bundles)
+        # runs for every reported query unless NDS_FLIGHT_RECORDER=off.
         watermark = host_rss_watermark(self.session)
         if hasattr(self.session, "_mem_pressure"):
             self.session._mem_pressure = False
@@ -630,7 +677,9 @@ class BenchReport:
         try:
             if sampler is not None:
                 sampler.__enter__()
+            att_t0 = time.perf_counter()
             err = self._attempt(fn, args, timeout)
+            att_ms = (time.perf_counter() - att_t0) * 1000.0
             while err is not None:
                 attempt_errors.append(err)
                 kind = faults.classify(err)
@@ -648,12 +697,18 @@ class BenchReport:
                     entry.update(detail)
                 rungs.append(entry)
                 if self.tracer is not None:
+                    # attempt_ms: the FAILED attempt's wall this rung is
+                    # recovering from — the critical-path profiler's
+                    # ladder-retry cause reads exactly this
                     self.tracer.emit(
                         "ladder_rung", query=name, rung=rung,
-                        failure_kind=kind, **(detail or {}),
+                        failure_kind=kind, attempt_ms=round(att_ms, 3),
+                        **(detail or {}),
                         **self._rid_fields(),
                     )
+                att_t0 = time.perf_counter()
                 err = self._attempt(fn, args, timeout)
+                att_ms = (time.perf_counter() - att_t0) * 1000.0
             if err is not None and faults.classify(err) == faults.DEVICE_OOM:
                 # terminal OOM: drop caches once more so the failure cannot
                 # poison the remaining stream (reference analogue: executor
@@ -685,6 +740,16 @@ class BenchReport:
             self.summary["queryStatus"].append("Failed")
             self.summary["exceptions"].extend(attempt_errors)
             self.summary["failureKind"] = faults.classify(err)
+            # flight recorder: a terminal failure leaves a self-contained
+            # bundle (ring + plan/budget/ladder/memory/conf) even with no
+            # trace dir configured — reason names what exhausted
+            kind = self.summary["failureKind"]
+            self._flight_flush(
+                "watchdog" if kind == faults.TIMEOUT
+                else "ladder_exhausted" if rungs
+                else "query_failed",
+                rungs, sampler=sampler,
+            )
         self.summary["startTime"] = start_time
         # epoch-ms difference is the queryTimes REPORT CONTRACT (reference
         # parity); the monotonic duration rides the query_span event below
@@ -712,6 +777,10 @@ class BenchReport:
             if sampler is not None and sampler.peak_bytes is not None:
                 ev["mem_hw_bytes"] = sampler.peak_bytes
                 ev["mem_source"] = sampler.source
+                if sampler.peak_per_device is not None:
+                    # per-device high-water (device-source runs): feeds
+                    # the /statusz mesh section and failure bundles
+                    ev["mem_hw_per_device"] = list(sampler.peak_per_device)
             ev.update(self._rid_fields())
             self.tracer.emit("query_span", **ev)
         return self.summary

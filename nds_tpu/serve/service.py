@@ -183,6 +183,12 @@ class _RequestTracer:
                 if fields.get("hit"):
                     self.tallies[f"{kind}_hits"] += 1
         fields.setdefault("request_id", self.request_id)
+        # the request id IS the serve-entry trace_id: every event this
+        # request's execution emits (op/kernel/exchange spans, ladder
+        # rungs, DM commits, heartbeats) carries ONE trace_id, overriding
+        # the shared session tracer's stream-level context — the whole
+        # request is followable end to end by a single grep
+        fields.setdefault("trace_id", self.request_id)
         fields.setdefault("tenant", self.tenant)
         if self._inner is not None:
             self._inner.emit(kind, **fields)
@@ -305,6 +311,10 @@ class QueryService:
             return
         fields = {
             "request_id": rid,
+            # admission verdicts are part of the request's trace: the
+            # serve_request event carries the same trace_id (= rid) the
+            # execution's spans do, so shed/rejected requests trace too
+            "trace_id": rid,
             "query": query,
             "verdict": verdict,
         }

@@ -223,7 +223,7 @@ def stream_wait_budget(query_timeout=None, n_queries: int = 103):
     return None
 
 
-def _fold_child_streams(tracer, trace_dir, pre_existing, procs):
+def _fold_child_streams(tracer, trace_dir, pre_existing, launches):
     """Fold the event files the child-stream processes wrote into the
     parent's own event log: one `child_stream` summary event per stream,
     plus a best-effort failure classification per stream (the parent only
@@ -231,8 +231,17 @@ def _fold_child_streams(tracer, trace_dir, pre_existing, procs):
     rotated (engine.trace_rotate_bytes) leaves a SEGMENT CHAIN; discovery
     returns it in rotation order (obs.reader.segment_key) and the filter
     below preserves that order, so the summary and the classification
-    read the child's whole stream in emission order. Returns
-    {stream_num: failure_kind} for streams whose events record a failure."""
+    read the child's whole stream in emission order.
+
+    Attribution is by TRACE CONTEXT, not pid: each file's `trace_meta`
+    line is verified against the stream's LAUNCH RECORD — the trace_id
+    the parent minted and exported (NDS_TRACE_CONTEXT) when authoritative,
+    else pid PLUS emission-time >= launch time. A recycled pid's leftover
+    file from some long-dead process can no longer mis-blame this run's
+    stream (the historical `-<pid>-` filename match trusted the pid
+    alone). `launches` is {stream_num: {"pid", "ts_ms", "trace_id"}}.
+    Returns {stream_num: failure_kind} for streams whose events record a
+    failure."""
     from .obs import reader as obs_reader
 
     kinds = {}
@@ -241,11 +250,27 @@ def _fold_child_streams(tracer, trace_dir, pre_existing, procs):
         for f in obs_reader.discover_event_files(trace_dir)
         if f not in pre_existing
     ]
-    for n, (p, _logf) in sorted(procs.items()):
-        # the child's app id embeds its pid (events-nds-tpu-<pid>-...);
-        # all rotation segments of one chain share the app id, so the
-        # pid match collects the full chain
-        mine = [f for f in new if f"-{p.pid}-" in os.path.basename(f)]
+    metas = {f: obs_reader.trace_meta_of(f) for f in new}
+    for n, rec in sorted(launches.items()):
+        mine = [
+            f for f in new
+            if (
+                obs_reader.meta_matches_launch(
+                    metas[f], pid=rec.get("pid"),
+                    launch_ts_ms=rec.get("ts_ms"),
+                    trace_id=rec.get("trace_id"),
+                )
+                # a NEW file with an unreadable/missing meta line (child
+                # killed before the eager meta landed, or its first line
+                # torn): keep the OLD pid-filename evidence so an
+                # instant death still yields its queries=0 marker — only
+                # files whose meta READS and mismatches are rejected
+                or (
+                    metas[f] is None
+                    and f"-{rec.get('pid')}-" in os.path.basename(f)
+                )
+            )
+        ]
         if not mine:
             continue
         try:
@@ -258,6 +283,7 @@ def _fold_child_streams(tracer, trace_dir, pre_existing, procs):
                 files=[os.path.basename(f) for f in mine],
                 queries=0, completed=0, failed={}, failure_kinds=[],
                 error=str(exc)[:200],
+                child_trace_id=rec.get("trace_id"),
             )
             continue
         s = obs_reader.summarize_stream(events)
@@ -269,6 +295,7 @@ def _fold_child_streams(tracer, trace_dir, pre_existing, procs):
             completed=s["completed"],
             failed=s["failed"],
             failure_kinds=s["failure_kinds"],
+            child_trace_id=rec.get("trace_id"),
         )
         k = obs_reader.failure_kind_from_events(events)
         if k is not None:
@@ -299,8 +326,15 @@ def _run_throughput_processes(
     conf = load_properties(property_file) if property_file else None
     trace_dir = obs_trace.resolve_trace_dir(conf)
     tracer = obs_trace.tracer_from_conf(conf)
+    # parent context: the children's trace_ids parent to it, so a folded
+    # log reads as one run even across the process boundary
+    parent_ctx = (
+        getattr(tracer, "context", None)
+        or obs_trace.resolve_trace_context("throughput")
+    )
     pre_existing = set(obs_reader.discover_event_files(trace_dir))
     procs = {}
+    launches = {}  # stream -> {"pid", "ts_ms", "trace_id"} (fold-in key)
     failures = {}
     try:
         for n, path in sorted(stream_paths.items()):
@@ -332,14 +366,24 @@ def _run_throughput_processes(
             # line is expected crash evidence, so no atomic rename here
             # nds-lint: disable=atomic-write
             logf = open(f"{time_log_base}_{n}.out", "w")
+            # per-child trace context: the child ADOPTS this exact
+            # trace_id (tracer_from_conf reads NDS_TRACE_CONTEXT), so the
+            # parent folds its event files by trace_id instead of pid
+            ctx = parent_ctx.child(f"stream{n}")
+            env = ctx.export(dict(os.environ))
             try:
                 p = subprocess.Popen(
-                    cmd, stdout=logf, stderr=subprocess.STDOUT
+                    cmd, stdout=logf, stderr=subprocess.STDOUT, env=env,
                 )
             except BaseException:
                 logf.close()
                 raise
             procs[n] = (p, logf)
+            launches[n] = {
+                "pid": p.pid,
+                "ts_ms": int(time.time() * 1000),
+                "trace_id": ctx.trace_id,
+            }
         budget = stream_wait_budget(
             query_timeout, len(sub_queries) if sub_queries else 103
         )
@@ -373,7 +417,7 @@ def _run_throughput_processes(
     if tracer is not None:
         try:
             child_kinds = _fold_child_streams(
-                tracer, trace_dir, pre_existing, procs
+                tracer, trace_dir, pre_existing, launches
             )
             for n, kind in child_kinds.items():
                 if n in failures:

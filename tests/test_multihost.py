@@ -106,6 +106,8 @@ def test_two_process_dcn_tier(tmp_path):
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
     coord = f"127.0.0.1:{port}"
+    from nds_tpu.parallel.multihost import worker_env
+
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     env.pop("JAX_PLATFORMS", None)
@@ -115,7 +117,9 @@ def test_two_process_dcn_tier(tmp_path):
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
-            env=env,
+            # worker_env exports a per-worker trace context on top of the
+            # sanitized env, so worker event files fold by trace_id
+            env=worker_env(process_id=pid, base=env),
             cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         )
         for pid in (0, 1)

@@ -39,12 +39,17 @@ def _clean_env(monkeypatch):
     monkeypatch.delenv("NDS_FAULT_SPEC", raising=False)
     monkeypatch.delenv("NDS_METRICS_PORT", raising=False)
     monkeypatch.delenv("NDS_TRACE_ROTATE_BYTES", raising=False)
+    monkeypatch.delenv("NDS_TRACE_CONTEXT", raising=False)
     faults.reset()
     yield
     faults.reset()
-    # the metrics sink/server are process-wide singletons by design; tests
-    # must not leak one test's counters (or a bound port) into the next
+    # the metrics sink/server and the flight ring are process-wide
+    # singletons by design; tests must not leak one test's counters (or a
+    # bound port, or ring events) into the next
     M.reset_shared()
+    from nds_tpu.obs import flight as FL
+
+    FL.reset_shared()
 
 
 def _scrape(port, path):
@@ -75,10 +80,28 @@ def _traced_session(tmp_path, **conf):
 # ---------------------------------------------------------------------------
 
 
-def test_tracer_disabled_by_default():
+def test_tracer_defaults_to_ring_only(monkeypatch):
+    """With nothing configured the session still gets a RING-ONLY tracer
+    (the always-on flight recorder): no file, no in-memory list, events
+    land in the process-wide bounded ring. NDS_FLIGHT_RECORDER=off
+    restores the historical fully-disabled None."""
+    from nds_tpu.obs import flight as FL
+
+    FL.reset_shared()
     s = Session()
-    assert s.tracer is None
+    assert s.tracer is not None
+    assert s.tracer.path is None and s.tracer.events is None
+    assert s.tracer.ring is FL.recorder()
+    assert s.tracer.context.trace_id
+    before = len(FL.recorder().snapshot())
+    s.tracer.emit("plan_cache", node="Aggregate", hit=True)
+    ring = FL.recorder().snapshot()
+    assert len(ring) == before + 1
+    assert ring[-1]["trace_id"] == s.tracer.context.trace_id
+    monkeypatch.setenv("NDS_FLIGHT_RECORDER", "off")
     assert tracer_from_conf({}) is None
+    assert Session().tracer is None
+    FL.reset_shared()
 
 
 def test_tracer_writes_meta_and_appends(tmp_path):
@@ -400,22 +423,30 @@ def test_fold_child_streams_emits_summary_and_classifies(tmp_path):
     trace_dir.mkdir()
     pid = 54321
     child = trace_dir / f"events-nds-tpu-{pid}-1-abc.jsonl"
+    now_ms = int(time.time() * 1000)
     _write_jsonl(child, [
-        _ev("trace_meta", pid=pid, version="0"),
+        _ev("trace_meta", pid=pid, version="0", ts=now_ms,
+            trace_id="tp-child-3"),
         _ev("query_span", query="query1", dur_ms=5, status="Completed",
             retries=0),
         _ev("query_span", query="query5", dur_ms=9, status="Failed",
             retries=2, failure_kind=faults.DEVICE_OOM),
     ], torn_tail='{"torn')
-
-    class FakeProc:
-        def __init__(self, pid):
-            self.pid = pid
+    # a leftover file from a RECYCLED pid (same pid, a different minted
+    # trace_id, stamped long before this launch): must NOT fold in
+    stale = trace_dir / f"events-nds-tpu-{pid}-0-old.jsonl"
+    _write_jsonl(stale, [
+        _ev("trace_meta", pid=pid, version="0", ts=now_ms - 86_400_000,
+            trace_id="tp-dead-run"),
+        _ev("query_span", query="query9", dur_ms=1, status="Failed",
+            retries=0, failure_kind=faults.TIMEOUT),
+    ])
 
     parent = Tracer()
     kinds = TP._fold_child_streams(
         parent, str(trace_dir), pre_existing=set(),
-        procs={3: (FakeProc(pid), None)},
+        launches={3: {"pid": pid, "ts_ms": now_ms - 100,
+                      "trace_id": "tp-child-3"}},
     )
     assert kinds == {3: faults.DEVICE_OOM}
     cs = [e for e in parent.events if e["kind"] == "child_stream"]
@@ -423,7 +454,49 @@ def test_fold_child_streams_emits_summary_and_classifies(tmp_path):
     assert cs[0]["stream"] == 3
     assert cs[0]["queries"] == 2 and cs[0]["completed"] == 1
     assert cs[0]["failed"] == {"query5": faults.DEVICE_OOM}
+    assert cs[0]["child_trace_id"] == "tp-child-3"
     assert R.validate_events(cs) == []
+
+
+def test_fold_child_streams_pid_fallback_rejects_stale(tmp_path):
+    """Pre-context children (no trace_id in the meta line) fold by pid
+    PLUS launch-time verification: a recycled pid's leftover file from a
+    long-dead process predates the launch record and is rejected."""
+    trace_dir = tmp_path / "trace"
+    trace_dir.mkdir()
+    pid = 777
+    now_ms = int(time.time() * 1000)
+    fresh = trace_dir / f"events-nds-tpu-{pid}-2-new.jsonl"
+    _write_jsonl(fresh, [
+        _ev("trace_meta", pid=pid, version="0", ts=now_ms),
+        _ev("query_span", query="q", dur_ms=1, status="Failed",
+            retries=0, failure_kind=faults.IO_TRANSIENT),
+    ])
+    stale = trace_dir / f"events-nds-tpu-{pid}-1-old.jsonl"
+    _write_jsonl(stale, [
+        _ev("trace_meta", pid=pid, version="0", ts=now_ms - 86_400_000),
+        _ev("query_span", query="q", dur_ms=1, status="Failed",
+            retries=0, failure_kind=faults.TIMEOUT),
+    ])
+    # a child killed BEFORE its eager meta line landed leaves an empty
+    # file: unverifiable, but still this pid's crash evidence — the
+    # pid-filename fallback keeps it (only a READABLE mismatching meta
+    # rejects)
+    empty = trace_dir / f"events-nds-tpu-{pid}-3-empty.jsonl"
+    empty.write_text("")
+    parent = Tracer()
+    kinds = TP._fold_child_streams(
+        parent, str(trace_dir), pre_existing=set(),
+        launches={1: {"pid": pid, "ts_ms": now_ms - 50}},
+    )
+    # only the fresh file's events attributed; the stale one never
+    # mis-blames (its TIMEOUT kind must not win)
+    assert kinds == {1: faults.IO_TRANSIENT}
+    cs = [e for e in parent.events if e["kind"] == "child_stream"]
+    assert len(cs) == 1
+    assert sorted(cs[0]["files"]) == sorted(
+        [os.path.basename(str(fresh)), os.path.basename(str(empty))]
+    )
 
 
 def test_phase_failure_classified_from_child_events(tmp_path, monkeypatch):
@@ -437,10 +510,15 @@ def test_phase_failure_classified_from_child_events(tmp_path, monkeypatch):
 
     def phase_fn():
         calls["n"] += 1
-        # simulate a child process that wrote events then died opaquely
+        # simulate a child process that wrote events then died opaquely;
+        # the child ADOPTS the phase's exported context (trace_meta
+        # trace_id), which is what the classifier now verifies against
         _write_jsonl(
             trace_dir / f"events-nds-tpu-99-{calls['n']}-x.jsonl",
-            [_ev("query_span", query="q", dur_ms=1, status="Failed",
+            [_ev("trace_meta", pid=99, version="0",
+                 ts=int(time.time() * 1000),
+                 trace_id=os.environ["NDS_TRACE_CONTEXT"].split(",")[0]),
+             _ev("query_span", query="q", dur_ms=1, status="Failed",
                  retries=0, failure_kind=faults.IO_TRANSIENT)],
         )
         if calls["n"] == 1:
@@ -755,9 +833,16 @@ def test_metrics_disabled_is_zero_cost(monkeypatch):
     monkeypatch.delenv("NDS_METRICS_PORT", raising=False)
     assert M.resolve_metrics_port({}) is None
     assert M.maybe_serve({}) is None
+    # with the flight recorder ALSO off, the historical fully-disabled
+    # zero-cost shape holds; by default the tracer is ring-only instead
+    monkeypatch.setenv("NDS_FLIGHT_RECORDER", "off")
     assert tracer_from_conf({}) is None
     s = Session()
     assert s.metrics is None and s.tracer is None
+    monkeypatch.delenv("NDS_FLIGHT_RECORDER", raising=False)
+    s2 = Session()
+    assert s2.metrics is None and s2.tracer is not None
+    assert s2.tracer.sink is None and s2.tracer.path is None
 
 
 def test_traced_session_feeds_sink_and_file(monkeypatch, tmp_path):

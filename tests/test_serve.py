@@ -411,6 +411,80 @@ def test_dml_commits_through_writer_path(tmp_path):
     service.close()
 
 
+def test_serve_request_traceable_by_single_trace_id(tmp_path):
+    """ISSUE-14 acceptance: ONE trace_id (= the request id) follows a
+    serve request end to end — the admission echo (serve_request), the
+    ladder rung its injected OOM walked, every op/catalog span of its
+    execution — and a DM request's lakehouse commit carries ITS id the
+    same way. Proven by grepping the folded event log for exactly one
+    trace_id per request."""
+    from nds_tpu.obs import reader as R
+
+    path = _mini_lake(tmp_path)
+    trace = tmp_path / "trace"
+    service, port, session = _make_service(
+        conf={"engine.trace_dir": str(trace)}, lake_path=path
+    )
+    faults.install("oom:serve:exec")  # one rung of ladder evidence
+    q = "select k, sum(v) s from fact group by k order by k"
+    status, body, _ = _post(port, {"sql": q})
+    assert status == 200 and body["retries"] == 1
+    rid = body["request_id"]
+    status, dm, _ = _post(
+        port,
+        {"sql": "insert into fact select k, v + 500 from fact where v < 4"},
+        tenant="writer",
+    )
+    assert status == 200
+    rid_dm = dm["request_id"]
+    evs = R.read_events(str(trace), strict=True)
+    assert R.validate_events(evs) == []
+    mine = [
+        e for e in evs
+        if e.get("request_id") == rid or e.get("trace_id") == rid
+    ]
+    kinds = {e["kind"] for e in mine}
+    assert {"serve_request", "op_span", "catalog_load", "query_span",
+            "ladder_rung", "fault_injected"} <= kinds
+    # exactly ONE trace_id across the request's whole event stream
+    assert {e["trace_id"] for e in mine} == {rid}
+    dm_evs = [e for e in evs if e.get("trace_id") == rid_dm]
+    dm_kinds = {e["kind"] for e in dm_evs}
+    assert {"serve_request", "lake_commit", "query_span"} <= dm_kinds
+    assert {e["trace_id"] for e in dm_evs} == {rid_dm}
+    # the two requests never alias
+    assert rid != rid_dm
+    service.close()
+
+
+def test_debug_jaxprof_start_stop_on_live_service(tmp_path):
+    """The on-demand jax.profiler verbs on the live listener: start
+    writes a trace under the requested dir, a second start conflicts,
+    stop ends it — all without touching in-flight query service."""
+    import glob as _glob
+
+    service, port, _ = _make_service()
+    prof_dir = str(tmp_path / "prof")
+    status, body, _ = _post(
+        port, {"action": "start", "dir": prof_dir}, path="/debug/jaxprof"
+    )
+    assert status == 200 and body["running"] and body["dir"] == prof_dir
+    status, body, _ = _post(port, {"action": "start"},
+                            path="/debug/jaxprof")
+    assert status == 409  # one profiler per process
+    # the service still answers queries while profiling
+    status, q, _ = _post(port, {"sql": "select count(*) c from fact"})
+    assert status == 200
+    status, body, _ = _post(port, {"action": "stop"},
+                            path="/debug/jaxprof")
+    assert status == 200 and body["running"] is False
+    assert _glob.glob(os.path.join(prof_dir, "**", "*"), recursive=True)
+    status, body, _ = _post(port, {"action": "bogus"},
+                            path="/debug/jaxprof")
+    assert status == 400
+    service.close()
+
+
 # ---------------------------------------------------------------------------
 # fault family: the server survives what its requests do not
 # ---------------------------------------------------------------------------

@@ -1,0 +1,271 @@
+#!/usr/bin/env python
+"""CI diagnosis gate (ISSUE 14): the flight recorder + critical-path
+profiler proven against real runs.
+
+    python tools/diagnosis_check.py [--data_dir D] [--mesh_trace DIR]
+
+Four checks, all against the marker-cached SF0.01 generated data:
+
+1. WATCHDOG BUNDLE — a power-CLI subprocess runs one query with an
+   injected hang and a 2 s watchdog, WITH NO TRACE DIR: the run must
+   leave a `failure-bundle-<trace_id>.json` in the flight dir and
+   `profile --check` must validate it (bundle keys + ring schema).
+2. CRASH BUNDLE — same stream with an injected `crash:exec` rule: the
+   process dies nonzero, and the bundle it flushed on the way down must
+   exist and validate.
+3. CRITICAL-PATH ATTRIBUTION — a traced mini power stream, then
+   `profile --critical-path --min_attributed 0.9` over its trace dir:
+   >= 90% of every query's wall must land on named causes. With
+   `--mesh_trace` (the mesh gate's dumped trace) the same assertion runs
+   over the 8-device stream AND the hot-key probe's straggler device
+   must be named.
+4. FLIGHT-RING OVERHEAD — the ring-only default must cost < 2% of the
+   SF0.01 stream's wall: the gate runs the stream with the ring on,
+   counts the events it actually recorded, measures the per-event
+   ring-emit cost in isolation, and asserts the modeled share
+   (events * cost / wall) stays under the budget. (A direct A/B of two
+   stream runs would drown the signal in CPU timing noise; the modeled
+   share is deterministic and errs high — emit cost is measured with
+   dict build included.)
+
+Exit 0 on success; nonzero with a diagnosis on any failure.
+"""
+
+import argparse
+import glob
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DATA_DEFAULT = "/tmp/nds_test_sf001"
+
+STREAM = """-- start query 1 in stream 0 using template query96.tpl
+select count(*) cnt from store_sales where ss_quantity > 0
+;
+-- end query 1 in stream 0 using template query96.tpl
+
+-- start query 2 in stream 0 using template query3.tpl
+select d_year, count(*) c from date_dim group by d_year order by d_year limit 5
+;
+-- end query 2 in stream 0 using template query3.tpl
+
+-- start query 3 in stream 0 using template query42.tpl
+select d_moy, sum(ss_ext_sales_price) s from store_sales, date_dim
+where ss_sold_date_sk = d_date_sk and d_year = 2000
+group by d_moy order by d_moy
+;
+-- end query 3 in stream 0 using template query42.tpl
+"""
+
+
+def ensure_data(data_dir):
+    marker = os.path.join(data_dir, ".complete")
+    if os.path.exists(marker):
+        return
+    subprocess.run(
+        [sys.executable, "-m", "nds_tpu.cli.gen_data", "--scale", "0.01",
+         "--parallel", "2", "--data_dir", data_dir, "--overwrite_output"],
+        check=True, cwd=REPO, capture_output=True,
+    )
+    open(marker, "w").close()
+
+
+def mini_warehouse(data_dir, dest):
+    os.makedirs(dest, exist_ok=True)
+    for t in ("store_sales", "date_dim"):
+        link = os.path.join(dest, t)
+        if not os.path.exists(link):
+            os.symlink(os.path.join(data_dir, t), link)
+    return dest
+
+
+def run_power(wh, stream_path, workdir, env_extra, expect_rc0=True):
+    env = dict(os.environ)
+    env.pop("NDS_TRACE_DIR", None)
+    env.pop("NDS_TRACE_CONTEXT", None)
+    env.update(env_extra)
+    p = subprocess.run(
+        [sys.executable, "-m", "nds_tpu.cli.power", wh, stream_path,
+         os.path.join(workdir, "time.csv"), "--input_format", "csv"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=600,
+    )
+    if expect_rc0 and p.returncode != 0:
+        fail(f"power run unexpectedly failed (rc={p.returncode}):\n"
+             f"{p.stdout[-2000:]}\n{p.stderr[-2000:]}")
+    return p
+
+
+def profile_cli(args):
+    return subprocess.run(
+        [sys.executable, "-m", "nds_tpu.cli.profile"] + args,
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+
+
+def fail(msg):
+    print(f"diagnosis_check: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def one_bundle(flight_dir):
+    bundles = glob.glob(os.path.join(flight_dir, "failure-bundle-*.json"))
+    if len(bundles) < 1:
+        fail(f"no failure bundle under {flight_dir}")
+    return bundles[0]
+
+
+def check_watchdog_bundle(wh, tmp):
+    flight = os.path.join(tmp, "flight-hang")
+    stream = os.path.join(tmp, "hang_stream.sql")
+    with open(stream, "w") as f:
+        f.write(STREAM)
+    run_power(wh, stream, tmp, {
+        "NDS_FAULT_SPEC": "hang:query96:30",
+        "NDS_QUERY_TIMEOUT": "2",
+        "NDS_FLIGHT_DIR": flight,
+    })
+    bundle = one_bundle(flight)
+    with open(bundle) as f:
+        b = json.load(f)
+    if b.get("reason") != "watchdog" or b.get("query") != "query96":
+        fail(f"watchdog bundle misattributed: reason={b.get('reason')} "
+             f"query={b.get('query')}")
+    p = profile_cli([bundle, "--check"])
+    if p.returncode != 0:
+        fail(f"profile --check rejected the watchdog bundle:\n{p.stderr}")
+    print(f"diagnosis_check: watchdog bundle ok ({os.path.basename(bundle)},"
+          f" {len(b['events'])} ring events)")
+
+
+def check_crash_bundle(wh, tmp):
+    flight = os.path.join(tmp, "flight-crash")
+    stream = os.path.join(tmp, "crash_stream.sql")
+    with open(stream, "w") as f:
+        f.write(STREAM)
+    p = run_power(wh, stream, tmp, {
+        "NDS_FAULT_SPEC": "crash:exec:query3",
+        "NDS_FLIGHT_DIR": flight,
+    }, expect_rc0=False)
+    if p.returncode == 0:
+        fail("crash-injected power run exited 0 (crash never fired?)")
+    bundle = one_bundle(flight)
+    with open(bundle) as f:
+        b = json.load(f)
+    if b.get("reason") != "crash":
+        fail(f"crash bundle reason={b.get('reason')}")
+    pc = profile_cli([bundle, "--check"])
+    if pc.returncode != 0:
+        fail(f"profile --check rejected the crash bundle:\n{pc.stderr}")
+    print(f"diagnosis_check: crash bundle ok ({len(b['events'])} ring "
+          f"events from the dying process)")
+
+
+def check_critical_path(wh, tmp, mesh_trace=None):
+    trace = os.path.join(tmp, "trace-cp")
+    stream = os.path.join(tmp, "cp_stream.sql")
+    with open(stream, "w") as f:
+        f.write(STREAM)
+    run_power(wh, stream, tmp, {"NDS_TRACE_DIR": trace})
+    p = profile_cli([trace, "--critical-path", "--min_attributed", "0.9"])
+    if p.returncode != 0:
+        fail(f"single-device critical path under 90% attribution:\n"
+             f"{p.stdout[-3000:]}\n{p.stderr}")
+    print("diagnosis_check: single-device critical path ok "
+          "(>= 90% of every query's wall attributed)")
+    if not mesh_trace:
+        return
+    if not glob.glob(os.path.join(mesh_trace, "events-*.jsonl")):
+        fail(f"mesh trace dir {mesh_trace} has no event files (did the "
+             f"mesh gate run with --trace_dir?)")
+    p = profile_cli(
+        [mesh_trace, "--critical-path", "--min_attributed", "0.9", "--json"]
+    )
+    if p.returncode != 0:
+        fail(f"mesh critical path under 90% attribution:\n"
+             f"{p.stdout[-3000:]}\n{p.stderr}")
+    cp = json.loads(p.stdout)
+    probe = cp["queries"].get("hotkey_probe")
+    if not probe or not probe.get("exchange"):
+        fail("mesh trace has no hot-key probe exchange evidence")
+    if probe["exchange"].get("straggler_device") is None:
+        fail("critical path failed to name the hot-key probe's straggler "
+             "device")
+    if (cp.get("mesh") or {}).get("straggler_device") is None:
+        fail("mesh summary names no straggler device")
+    print(f"diagnosis_check: mesh critical path ok (straggler device "
+          f"{probe['exchange']['straggler_device']} on the hot-key probe, "
+          f"skew share "
+          f"{(cp['mesh'] or {}).get('skew_share')})")
+
+
+def check_ring_overhead(wh, tmp):
+    # in-process: run the mini stream ring-only and model the ring's share
+    os.environ.pop("NDS_TRACE_DIR", None)
+    os.environ["NDS_FLIGHT_DIR"] = os.path.join(tmp, "flight-oh")
+    from nds_tpu.obs import flight as FL
+    from nds_tpu.obs.trace import Tracer
+    from nds_tpu.power import gen_sql_from_stream, run_query_stream
+
+    FL.reset_shared()
+    rec = FL.recorder()
+    stream = os.path.join(tmp, "oh_stream.sql")
+    with open(stream, "w") as f:
+        f.write(STREAM)
+    before = rec.events_recorded
+    t0 = time.perf_counter()
+    run_query_stream(
+        input_prefix=wh, property_file=None,
+        query_dict=gen_sql_from_stream(stream),
+        time_log_output_path=os.path.join(tmp, "oh_time.csv"),
+        input_format="csv",
+    )
+    wall_s = time.perf_counter() - t0
+    n_events = rec.events_recorded - before
+    if n_events <= 0:
+        fail("ring-only stream recorded no events (ring wired wrong?)")
+    # isolated per-event cost of the ring path (dict build + stamp + append)
+    tr = Tracer(None, collect=False)  # ring-only shape
+    n_bench = 50_000
+    t0 = time.perf_counter()
+    for i in range(n_bench):
+        tr.emit("plan_cache", node="Aggregate", hit=False, query="q")
+    per_event_s = (time.perf_counter() - t0) / n_bench
+    share = (per_event_s * n_events) / wall_s
+    print(f"diagnosis_check: flight ring recorded {n_events} events over "
+          f"{wall_s:.2f}s; {per_event_s * 1e6:.1f}us/event -> modeled "
+          f"share {share:.3%} of wall (budget 2%)")
+    if share >= 0.02:
+        fail(f"flight-ring overhead {share:.2%} exceeds the 2% budget")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--data_dir", default=os.environ.get(
+        "NDS_DIAG_DATA", DATA_DEFAULT))
+    ap.add_argument("--mesh_trace", default=None,
+                    help="mesh gate trace dir (mesh_stream_check "
+                    "--trace_dir) for the mesh-mode attribution check")
+    args = ap.parse_args(argv)
+    ensure_data(args.data_dir)
+    tmp = tempfile.mkdtemp(prefix="nds_diag_")
+    try:
+        wh = mini_warehouse(args.data_dir, os.path.join(tmp, "wh"))
+        check_watchdog_bundle(wh, tmp)
+        check_crash_bundle(wh, tmp)
+        check_critical_path(wh, tmp, mesh_trace=args.mesh_trace)
+        check_ring_overhead(wh, tmp)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    print("diagnosis_check: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

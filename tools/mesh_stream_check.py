@@ -115,9 +115,22 @@ def _canon_rows(arrow):
     return sorted(rows, key=key)
 
 
-def run_stream(oracle, dist, queries):
+def run_stream(oracle, dist, queries, tracer=None):
+    from nds_tpu import faults
+
     matched, mismatched, failed = [], {}, {}
     wall_oracle = wall_mesh = 0.0
+
+    def span(name, dur_s, status):
+        # the mesh half runs outside BenchReport, so the gate emits the
+        # query_span itself — `profile --critical-path` over the dumped
+        # trace needs per-query wall to attribute against
+        if tracer is not None:
+            tracer.emit(
+                "query_span", query=name,
+                dur_ms=round(dur_s * 1000.0, 3), status=status, retries=0,
+            )
+
     for i, (name, sql) in enumerate(queries.items()):
         try:
             t0 = time.perf_counter()
@@ -125,9 +138,16 @@ def run_stream(oracle, dist, queries):
             a_rows = _canon_rows(a.collect()) if a is not None else []
             wall_oracle += time.perf_counter() - t0
             t0 = time.perf_counter()
-            b = dist.run_script(sql)
-            b_rows = _canon_rows(b.collect()) if b is not None else []
-            wall_mesh += time.perf_counter() - t0
+            try:
+                with faults.scope(name):  # query-scoped exchange evidence
+                    b = dist.run_script(sql)
+                    b_rows = _canon_rows(b.collect()) if b is not None else []
+            except Exception:
+                span(name, time.perf_counter() - t0, "Failed")
+                raise
+            mesh_dur = time.perf_counter() - t0
+            wall_mesh += mesh_dur
+            span(name, mesh_dur, "Completed")
         except Exception as exc:
             failed[name] = f"{type(exc).__name__}: {str(exc)[:300]}"
             print(f"[{i + 1}/{len(queries)}] {name}: FAILED {exc}",
@@ -185,10 +205,19 @@ def overflow_retry_probe(n_dev: int):
     for s in (oracle, dist):
         s.register_arrow("l", left)
         s.register_arrow("r", right)
+    from nds_tpu import faults
+
     q = ("select count(*) c, sum(lv) sl, sum(rv) sr from l, r "
          "where l.k = r.k")
     a = oracle.sql(q).to_pylist()
-    b = dist.sql(q).to_pylist()
+    t0 = time.perf_counter()
+    with faults.scope("hotkey_probe"):
+        b = dist.sql(q).to_pylist()
+    tracer.emit(
+        "query_span", query="hotkey_probe",
+        dur_ms=round((time.perf_counter() - t0) * 1000.0, 3),
+        status="Completed", retries=0,
+    )
     if a != b:
         raise AssertionError(f"overflow probe mismatch: {a} vs {b}")
     ev = [e for e in tracer.events if e["kind"] == "exchange"]
@@ -205,6 +234,10 @@ def overflow_retry_probe(n_dev: int):
             [e["retries"] for e in ev] + [1 if retries_seen else 0]
         ),
         "skew": skew,
+        # the probe tracer's raw events ride back so --trace_dir can dump
+        # them (main pops this key before the JSON artifact is written)
+        "events": (tracer.events, tracer.app_id,
+                   tracer.context.trace_id),
     }
 
 
@@ -229,6 +262,12 @@ def main(argv=None) -> int:
         help="MULTICHIP_r*.json to compare against (default: newest in "
         "the repo root; comparison is fail-soft)",
     )
+    ap.add_argument(
+        "--trace_dir", default=None,
+        help="also dump the gate's collected events (stream + hot-key "
+        "probe) as event files under this dir — ci/tier1-check runs "
+        "`profile --critical-path` over it",
+    )
     args = ap.parse_args(argv)
 
     _force_cpu_mesh(args.devices)
@@ -251,7 +290,7 @@ def main(argv=None) -> int:
 
     oracle, dist, tracer = _sessions(args.data_dir, args.devices)
     matched, mismatched, failed, w_oracle, w_mesh = run_stream(
-        oracle, dist, queries
+        oracle, dist, queries, tracer=tracer
     )
 
     # stream-level exchange evidence: the retired dryrun caps mean the
@@ -264,6 +303,27 @@ def main(argv=None) -> int:
         probe = overflow_retry_probe(args.devices)
     except Exception as exc:  # recorded below; fails the gate
         probe_error = f"{type(exc).__name__}: {str(exc)[:300]}"
+    probe_events = probe.pop("events", None)
+
+    if args.trace_dir:
+        # dump the in-memory streams as regular event files (meta line
+        # first) so the profiler CLI reads them like any trace dir
+        os.makedirs(args.trace_dir, exist_ok=True)
+        chains = [(tracer.events, tracer.app_id, tracer.context.trace_id)]
+        if probe_events is not None:
+            chains.append(probe_events)
+        from nds_tpu import __version__ as _v
+
+        for evs, app, tid in chains:
+            path = os.path.join(args.trace_dir, f"events-{app}.jsonl")
+            with open(path, "w") as f:
+                f.write(json.dumps({
+                    "ts": int(time.time() * 1000), "kind": "trace_meta",
+                    "app": app, "trace_id": tid, "pid": os.getpid(),
+                    "version": _v,
+                }) + "\n")
+                for ev in evs:
+                    f.write(json.dumps(ev, default=str) + "\n")
 
     ok = (
         not mismatched
