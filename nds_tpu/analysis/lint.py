@@ -61,6 +61,17 @@ Every rule below encodes a bug this codebase actually shipped (and fixed):
                           its attach_app seam, and nothing else may
                           construct an HTTP server. Scope: everywhere
                           except obs/httpserv.py.
+  manifest-write-seam     the PR-15 single-committer invariant (the
+                          debug-route-seam pattern, applied to storage):
+                          lakehouse manifest/commit-log writes happen
+                          ONLY inside the committer/catalog API
+                          (lakehouse/table.py `_commit` +
+                          lakehouse/catalog.py) — a `put_if_absent` call
+                          or a `_manifests` path built anywhere else is
+                          a second committer that bypasses OCC
+                          arbitration, the fence check, and the
+                          coordinator's WAL. Scope: everywhere except
+                          the two committer modules.
   cache-lock-discipline   the serve work (ROADMAP item 4) makes the
                           session caches (exec_cache, join_order_cache,
                           pallas_promotions, plan_cache) multi-tenant;
@@ -432,6 +443,69 @@ def _r_debug_route_seam(tree, relpath):
                 f"HTTP server constructed outside {_LISTENER_MODULE}; "
                 f"the process has ONE listener (obs/httpserv.py) — "
                 f"attach new surfaces through attach_app"
+            )))
+    return out
+
+
+#: the only modules allowed to publish lakehouse manifests / touch the
+#: commit log: the table committer and the fleet catalog it routes through
+_COMMITTER_MODULES = ("lakehouse/table.py", "lakehouse/catalog.py")
+
+
+def _collect_docstring_ids(tree):
+    """ids of module/class/function docstring Constant nodes (shared by
+    the seam rules: prose route tables / path examples must not trip)."""
+    doc_ids = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            body = getattr(node, "body", [])
+            if body and isinstance(body[0], ast.Expr) and isinstance(
+                body[0].value, ast.Constant
+            ) and isinstance(body[0].value.value, str):
+                doc_ids.add(id(body[0].value))
+    return doc_ids
+
+
+@_rule("manifest-write-seam", _scope_all)
+def _r_manifest_write_seam(tree, relpath):
+    """The single-committer invariant, mechanized: every manifest publish
+    routes through `LakehouseTable._commit` (which itself routes through
+    lakehouse/catalog.py when a fleet catalog is configured). A
+    `put_if_absent` call or a `_manifests` path literal anywhere else is
+    a second committer — it would bypass OCC arbitration, the epoch
+    fence, and the coordinator's WAL, exactly the storage-corruption
+    class the catalog service exists to close."""
+    if relpath in _COMMITTER_MODULES or relpath == "analysis/lint.py":
+        return []
+    out = []
+    doc_ids = _collect_docstring_ids(tree)
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and "_manifests" in node.value
+            and id(node) not in doc_ids
+        ):
+            out.append((node.lineno, (
+                f"manifest path {node.value!r} built outside the committer "
+                f"modules ({', '.join(_COMMITTER_MODULES)}); manifest/"
+                f"commit-log writes go through LakehouseTable._commit and "
+                f"the catalog API — no second committer"
+            )))
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, (ast.Name, ast.Attribute))
+            and (
+                node.func.id if isinstance(node.func, ast.Name)
+                else node.func.attr
+            ) == "put_if_absent"
+        ):
+            out.append((node.lineno, (
+                f"put_if_absent() called outside the committer modules "
+                f"({', '.join(_COMMITTER_MODULES)}); the create-exclusive "
+                f"publish primitive belongs to the commit seam — route "
+                f"writes through LakehouseTable._commit / the catalog"
             )))
     return out
 

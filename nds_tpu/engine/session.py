@@ -301,16 +301,14 @@ class Catalog:
             self.invalidate(name)
             e.pinned_version = snap.version
             e.pinned_snapshot = snap
-            e.lease_id = LEASES.acquire(
-                lt.root, snap.version, snap.rel_files, ttl
-            )
+            # registers locally AND (catalog mode) in the fleet catalog,
+            # so a vacuum on another host respects this pin too
+            e.lease_id = lt.acquire_reader_lease(snap, ttl)
         else:
             if e.pinned_snapshot is None:
                 e.pinned_snapshot = snap
             if e.lease_id is None or not LEASES.renew(e.lease_id, ttl):
-                e.lease_id = LEASES.acquire(
-                    lt.root, snap.version, snap.rel_files, ttl
-                )
+                e.lease_id = lt.acquire_reader_lease(snap, ttl)
         return e.pinned_version
 
     def hold_pins(self, names):
@@ -397,7 +395,7 @@ class Catalog:
             and (snap is None or snap.version != lake_version)
         )
         if detached:
-            from ..lakehouse.leases import LEASES, resolve_lease_ttl
+            from ..lakehouse.leases import resolve_lease_ttl
             from ..lakehouse.table import LakehouseTable
 
             lt = LakehouseTable(e.path, conf=self.session.conf)
@@ -409,9 +407,8 @@ class Catalog:
             # mid-scan. No release point exists (the statement may keep
             # re-loading), so expiry is the TTL's job — the lease
             # table's documented leak bound.
-            LEASES.acquire(
-                lt.root, snap.version, snap.rel_files,
-                resolve_lease_ttl(self.session.conf),
+            lt.acquire_reader_lease(
+                snap, resolve_lease_ttl(self.session.conf)
             )
         missing = (
             list(columns) if detached
@@ -589,6 +586,28 @@ class Catalog:
                 c.stats,
             )
         return Table(cols, t.nrows)
+
+    def renew_lake_leases(self) -> int:
+        """Renew every lakehouse entry's reader lease (local table +
+        catalog write-through) — the memwatch heartbeat calls this so a
+        statement outliving `engine.lake_lease_ttl_s` (a slow SF100-scale
+        scan) can never have its pinned snapshot vacuumed mid-read; the
+        pre-heartbeat behavior only renewed on re-resolution. Returns the
+        number of leases renewed. Best-effort: an expired lease is left
+        for the next pin_lakehouse to re-acquire (the files it protected
+        are re-checked through the plan's own detached path)."""
+        from ..lakehouse.leases import LEASES, resolve_lease_ttl
+
+        ttl = resolve_lease_ttl(self.session.conf)
+        renewed = 0
+        for e in list(self.entries.values()):
+            if e.fmt == "lakehouse" and e.lease_id is not None:
+                try:
+                    if LEASES.renew(e.lease_id, ttl):
+                        renewed += 1
+                except Exception:
+                    continue  # renewal must never take a query down
+        return renewed
 
     def invalidate(self, name):
         self.session._catalog_changed()

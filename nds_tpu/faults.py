@@ -33,6 +33,14 @@ Injection sites fired around the codebase:
     stage:<table_name>    lakehouse staged-data write (io/crash kinds only)
     manifest:<table_name> lakehouse manifest read (io/crash kinds only)
     vacuum:<table_name>   lakehouse vacuum delete (io/crash kinds only)
+    catalog:commit        fleet-catalog commit arbitration; on the tcp
+                          coordinator it fires BETWEEN the WAL intent and
+                          the manifest publish — the crash-mid-commit
+                          chaos window (io/hang/crash kinds only)
+    catalog:lease         fleet-catalog lease/writer registration
+                          (io/hang/crash kinds only)
+    catalog:fence         fleet-catalog fence bump during vacuum
+                          (io/hang/crash kinds only)
     <phase_name>          full_bench phase runner (e.g. power_test)
     serve:admit           serve-mode admission path (request is SHED 429,
                           never the server)
@@ -98,6 +106,11 @@ _IO_PAT = (
     # host-tier write/read is storage flakiness, not a query bug — the
     # ladder's io_backoff_retry rung owns it
     "SpillIOError",
+    # fleet-catalog coordinator down (lakehouse/catalog.py
+    # CatalogUnreachableError, a ConnectionError subclass — this pattern
+    # covers re-rendered strings): writes back off and retry while pinned
+    # reads, which never need the coordinator, keep serving
+    "catalog unreachable",
 )
 # CommitConflictError (lakehouse/table.py): an optimistic lakehouse commit
 # lost the publish race and could not rebase. The transaction never
@@ -105,7 +118,13 @@ _IO_PAT = (
 # ladder's commit_rebase_retry rung owns it (with jittered backoff). Checked
 # before DATA: the conflict is a LakehouseError subclass, but it is the one
 # lakehouse failure that is TRANSIENT, not deterministic.
-_COMMIT_PAT = ("CommitConflictError", "concurrent commit conflict")
+_COMMIT_PAT = (
+    "CommitConflictError", "concurrent commit conflict",
+    # CatalogFencedError (lakehouse/catalog.py): a vacuum fenced this
+    # writer's epoch — the transaction never published and re-runs with a
+    # fresh registration, same rung as a lost CAS race
+    "CatalogFencedError", "fenced by catalog",
+)
 # PlanVerifyError: the static plan verifier (analysis/verifier.py) found a
 # structural invariant violation — deterministic, so the ladder fails fast.
 # PlanBudgetError: admission control (analysis/budget.py) refused the plan

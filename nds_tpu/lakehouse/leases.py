@@ -63,15 +63,21 @@ class ReaderLeases:
         self._ids = itertools.count(1)
         self._leases = {}  # id -> {root, version, files, expires}
 
-    def acquire(self, root: str, version: int, files, ttl_s: float) -> int:
+    def acquire(self, root: str, version: int, files, ttl_s: float,
+                remote=None) -> int:
         """Register a pin of `version` over `files` (manifest-relative
-        paths) of the table at `root`; returns the lease id."""
+        paths) of the table at `root`; returns the lease id. `remote` is
+        an optional catalog lease handle (lakehouse/catalog.py
+        RemoteLease): when present, renew/release forward to it — this
+        table is then the local cache of catalog state, and vacuum on
+        OTHER hosts sees the catalog half."""
         lease_id = next(self._ids)
         rec = {
             "root": str(root),
             "version": int(version),
             "files": frozenset(str(f) for f in files),
             "expires": _monotonic() + float(ttl_s),
+            "remote": remote,
         }
         with self._lock:
             self._prune(_monotonic())
@@ -80,7 +86,13 @@ class ReaderLeases:
 
     def renew(self, lease_id: int, ttl_s: float) -> bool:
         """Extend a live lease; False when it already expired/was released
-        (caller should re-acquire)."""
+        (caller should re-acquire). Forwards to the catalog half when the
+        lease was written through — THROTTLED to once per ttl/3 (with a
+        short failure backoff), because renew() runs on the memwatch
+        heartbeat thread and a blocking remote call every beat would
+        stall the OOM-watermark sampling the thread exists for. A missed
+        remote renewal falls back to the remote TTL, never blocks the
+        local pin."""
         now = _monotonic()
         with self._lock:
             self._prune(now)
@@ -88,11 +100,35 @@ class ReaderLeases:
             if rec is None:
                 return False
             rec["expires"] = now + float(ttl_s)
-            return True
+            remote = rec.get("remote")
+            if remote is not None and now < rec.get("remote_next", 0.0):
+                remote = None  # remote half renewed recently enough
+            if remote is not None:
+                # claim the slot BEFORE the (unlocked) network call so
+                # concurrent renewers don't pile onto a slow coordinator
+                rec["remote_next"] = now + max(float(ttl_s) / 3.0, 0.05)
+        if remote is not None:
+            try:
+                if not remote.renew(ttl_s):
+                    raise OSError("remote lease renewal refused")
+            except Exception:
+                # remote TTL is the backstop; back off so a down
+                # coordinator costs at most one short timeout per 5s
+                with self._lock:
+                    rec = self._leases.get(lease_id)
+                    if rec is not None:
+                        rec["remote_next"] = _monotonic() + 5.0
+        return True
 
     def release(self, lease_id: int) -> bool:
         with self._lock:
-            return self._leases.pop(lease_id, None) is not None
+            rec = self._leases.pop(lease_id, None)
+        if rec is not None and rec.get("remote") is not None:
+            try:
+                rec["remote"].release()
+            except Exception:
+                pass  # remote TTL expiry is the backstop
+        return rec is not None
 
     def _prune(self, now: float):
         dead = [i for i, r in self._leases.items() if r["expires"] <= now]

@@ -16,6 +16,13 @@ Writers stage data files first, then commit by publishing the next manifest
 appends a new manifest replaying an older file list — history is never
 rewritten, matching Iceberg's rollback_to_timestamp semantics.
 
+Fleet concurrency: with `engine.lake_catalog` configured the publish,
+reader-lease registration, and vacuum fence route through the catalog
+service (lakehouse/catalog.py — fs CAS or a tcp coordinator), giving
+multi-HOST writers commit arbitration, cross-host lease visibility, and
+epoch fencing (a stale zombie writer can never publish). Off by default:
+everything below then describes the process-concurrent behavior exactly.
+
 Concurrency (the Iceberg optimistic-concurrency model, in-process scale):
 
 * **Snapshot-isolated reads** — `snapshot(version)` returns a TableSnapshot
@@ -66,6 +73,7 @@ import pyarrow.dataset as pads
 import pyarrow.parquet as pq
 
 from ..io.fs import get_fs, put_if_absent
+from .catalog import CatalogFencedError, resolve_catalog, resolve_writer_ttl
 from .leases import LEASES
 
 _MANIFEST_DIR = "_manifests"
@@ -73,9 +81,12 @@ _DATA_DIR = "data"
 
 #: staged data files / manifest temps embed the writer pid so crash
 #: hygiene can liveness-check the owner (spill.py's pid-manifest pattern);
-#: pre-existing tables' `part-<hex>.parquet` files still read fine through
-#: their manifests — the sweep just never attributes (or touches) them
-_STAGED_RE = re.compile(r"^part-(\d+)-[0-9a-f]{12}\.parquet$")
+#: with a catalog configured they ALSO embed the writer's fencing epoch
+#: (`part-<pid>-e<epoch>-<hex>.parquet`) so vacuum can attribute stages
+#: across hosts without pids. Pre-existing tables' `part-<hex>.parquet`
+#: files still read fine through their manifests — the sweep just never
+#: attributes (or touches) them
+_STAGED_RE = re.compile(r"^part-(\d+)(?:-e(\d+))?-[0-9a-f]{12}\.parquet$")
 _TMP_MANIFEST_RE = re.compile(r"^\.tmp-(\d+)-[0-9a-f]+\.json$")
 _DATA_FILE_RE = re.compile(r"^part-[0-9a-f-]+\.parquet$")
 
@@ -214,6 +225,12 @@ class LakehouseTable:
         self.fs, self.root = get_fs(path)
         self.manifest_dir = posixpath.join(self.root, _MANIFEST_DIR)
         self.data_dir = posixpath.join(self.root, _DATA_DIR)
+        # fleet catalog (lakehouse/catalog.py): when configured, commits,
+        # reader leases and the vacuum fence route through it — cross-host
+        # arbitration. None (the default) keeps the PR-10 process-
+        # concurrent behavior byte for byte.
+        self.catalog = resolve_catalog(conf)
+        self._writer_token = None  # lazy catalog writer registration
         if not self.fs.isdir(self.manifest_dir):
             raise LakehouseError(f"{path} is not a lakehouse table")
 
@@ -231,6 +248,64 @@ class LakehouseTable:
             else self.fs.protocol[0]
         )
         return proto in ("file", "local")
+
+    # -- fleet catalog -----------------------------------------------------
+    def _writer_epoch(self) -> int | None:
+        """This instance's catalog writer epoch (registering a TTL-bounded
+        writer lease on first use); None with no catalog configured."""
+        if self.catalog is None:
+            return None
+        if self._writer_token is None:
+            self._writer_token = self.catalog.writer_register(
+                self, resolve_writer_ttl(self.conf)
+            )
+        return int(self._writer_token["epoch"])
+
+    def _release_writer(self):
+        """Drop this instance's writer lease after its transaction ends
+        (published or aborted-and-discarded): the fence can then advance
+        past the epoch at the next vacuum instead of waiting out the TTL.
+        The next transaction on this instance re-registers."""
+        token, self._writer_token = self._writer_token, None
+        if token is None or self.catalog is None:
+            return
+        try:
+            # a writer lease is a writer-epoch record in the same store;
+            # the catalog expires it immediately by zeroing its TTL
+            self.catalog.writer_renew(self, token, 0.0)
+        except Exception:
+            pass  # TTL expiry is the backstop
+
+    def acquire_reader_lease(self, snapshot, ttl_s: float) -> int:
+        """Register a reader lease over a snapshot's files: in the
+        process-wide lease table ALWAYS, and — with a catalog configured
+        — written through to the catalog so vacuum on ANY host sees it
+        (the in-process table is then the local cache of catalog state).
+        Returns the local lease id (renew/release forward to the remote
+        half automatically)."""
+        remote = None
+        if self.catalog is not None:
+            remote = self.catalog.lease_acquire(
+                self, snapshot.version, snapshot.rel_files, ttl_s
+            )
+        return LEASES.acquire(
+            self.root, snapshot.version, snapshot.rel_files, ttl_s,
+            remote=remote,
+        )
+
+    def _held_files(self) -> set:
+        """Files protected by live reader leases: the local table merged
+        with the catalog's cross-host view."""
+        out = LEASES.held_files(self.root)
+        if self.catalog is not None:
+            out |= self.catalog.held_files(self)
+        return out
+
+    def _held_versions(self) -> set:
+        out = LEASES.held_versions(self.root)
+        if self.catalog is not None:
+            out |= self.catalog.held_versions(self)
+        return out
 
     # -- creation ----------------------------------------------------------
     @classmethod
@@ -348,12 +423,18 @@ class LakehouseTable:
         out = None
         relpath = None
         n_rows = 0
+        # with a catalog, staged names carry the writer's fencing epoch so
+        # a vacuum on ANY host can attribute the stage (pids are host-local)
+        epoch_tag = (
+            f"-e{self._writer_epoch()}" if self.catalog is not None else ""
+        )
         try:
             for b in batches:
                 if writer is None:
                     relpath = posixpath.join(
                         _DATA_DIR,
-                        f"part-{os.getpid()}-{uuid.uuid4().hex[:12]}.parquet",
+                        f"part-{os.getpid()}{epoch_tag}"
+                        f"-{uuid.uuid4().hex[:12]}.parquet",
                     )
                     out = self.fs.open(
                         posixpath.join(self.root, relpath), "wb"
@@ -380,6 +461,7 @@ class LakehouseTable:
                 self.fs.rm_file(posixpath.join(self.root, rel))
             except OSError:
                 pass
+        self._release_writer()  # the aborted transaction's epoch is done
 
     def _commit(self, staged, operation, base_files=None, num_rows=None,
                 schema=None):
@@ -440,19 +522,49 @@ class LakehouseTable:
             }
             if _COMMIT_HOOK is not None:
                 _COMMIT_HOOK(self.name, operation, version)
-            tmp = posixpath.join(
-                self.manifest_dir,
-                f".tmp-{os.getpid()}-{uuid.uuid4().hex}.json",
-            )
-            with self.fs.open(tmp, "w") as fh:
-                json.dump(manifest, fh)
             # optimistic concurrency: publish is create-exclusive, so a
             # concurrent writer that claimed the same version fails loudly
             # instead of silently last-writer-winning (Iceberg's
-            # commit-conflict guarantee; see io/fs.py put_if_absent for the
-            # local-atomic vs remote-best-effort split)
-            dest = posixpath.join(self.manifest_dir, f"v{version:06d}.json")
-            if put_if_absent(self.fs, tmp, dest):
+            # commit-conflict guarantee). With a catalog the publish routes
+            # through it — fence-checked, and on the tcp backend serialized
+            # + WAL-journaled by the coordinator; without one it is the
+            # PR-10 direct path (see io/fs.py put_if_absent for the
+            # local-atomic vs remote-best-effort split).
+            if self.catalog is not None:
+                try:
+                    epoch = self._writer_epoch()
+                    # keep the writer lease live across the rebase loop so
+                    # a long conflict storm can't expire us into the fence
+                    self.catalog.writer_renew(
+                        self, self._writer_token,
+                        resolve_writer_ttl(self.conf),
+                    )
+                    published = self.catalog.commit(
+                        self, manifest, epoch=epoch
+                    )
+                except CatalogFencedError as exc:
+                    # a vacuum fenced this writer (lease expired — zombie
+                    # presumption) and may have reclaimed its stage: the
+                    # whole transaction must re-run with a fresh epoch and
+                    # fresh staged files. CommitConflictError routes it to
+                    # the ladder's commit_rebase_retry rung.
+                    self._release_writer()
+                    raise CommitConflictError(
+                        f"{self.path}: {exc} (re-run the transaction)"
+                    ) from exc
+            else:
+                tmp = posixpath.join(
+                    self.manifest_dir,
+                    f".tmp-{os.getpid()}-{uuid.uuid4().hex}.json",
+                )
+                with self.fs.open(tmp, "w") as fh:
+                    json.dump(manifest, fh)
+                dest = posixpath.join(
+                    self.manifest_dir, f"v{version:06d}.json"
+                )
+                published = put_if_absent(self.fs, tmp, dest)
+            if published:
+                self._release_writer()
                 tracer = _tracer()
                 if tracer is not None:
                     tracer.emit(
@@ -478,6 +590,11 @@ class LakehouseTable:
                     if base_files is None
                     else "overwrite transactions cannot rebase"
                 )
+                # drop the writer lease HERE, not only in _discard_staged:
+                # rollback transactions reach this raise with no staged
+                # files and would otherwise pin the fence for the full
+                # writer TTL (idempotent — the discard path re-calls it)
+                self._release_writer()
                 raise CommitConflictError(
                     f"{self.path}: concurrent commit conflict at version "
                     f"{version} after {attempts} attempt(s) ({why}); "
@@ -551,7 +668,7 @@ class LakehouseTable:
         vs = self.versions()
         retain_last = self._retain_last(retain_last)
         keep = {v for v, _, _ in vs[-retain_last:]}
-        leased = LEASES.held_versions(self.root)
+        leased = self._held_versions()
         expired = []
         for v, ts, _ in vs:
             if v in keep or v in leased:
@@ -590,19 +707,30 @@ class LakehouseTable:
         committed = self._all_referenced_files()
         expired = self.expire_snapshots(retain_last, older_than_ms)
         referenced = self._all_referenced_files()
-        leased = LEASES.held_files(self.root)
+        leased = self._held_files()
+        # epoch fencing (catalog mode): advance the fence to the minimum
+        # LIVE writer epoch BEFORE collecting. Any never-referenced stage
+        # with epoch < fence belongs to a writer whose publish is now
+        # refused at the catalog, so deleting it can never tear a commit —
+        # the cross-host replacement for pid-liveness attribution, and the
+        # close of PR-10's publish-vs-unlink window (airtight on the tcp
+        # backend, rename-narrowed on fs).
+        fence = None
+        if self.catalog is not None:
+            fence = self.catalog.bump_fence(self)
+            self.catalog.sweep_expired(self)
         removed, leased_kept, bytes_removed = [], 0, 0
         try:
             entries = self.fs.ls(self.data_dir, detail=True)
         except OSError:
             entries = []
-        # re-read the manifest log AFTER listing the data dir: a commit
-        # that published between the first referenced-set read and the
-        # listing (a racing writer that then exited, defeating the
-        # pid-liveness guard) must land in `referenced` before anything
-        # is deleted. The residual publish-vs-unlink window is the same
-        # one Iceberg closes with a catalog service; single-process
-        # maintenance windows (the shipped harnesses) never race it.
+        # re-read the manifest log AFTER the fence bump and the data-dir
+        # listing: a commit that published between the first referenced-set
+        # read and the listing (a racing writer that then exited, defeating
+        # the pid-liveness guard) must land in `referenced` before anything
+        # is deleted. Without a catalog the residual publish-vs-unlink
+        # window is the one Iceberg closes with a catalog service —
+        # configure `engine.lake_catalog` to close it here too.
         referenced |= self._all_referenced_files()
         for ent in entries:
             full = ent["name"] if isinstance(ent, dict) else str(ent)
@@ -616,17 +744,20 @@ class LakehouseTable:
                 leased_kept += 1
                 continue
             m = _STAGED_RE.match(base)
-            if (
-                rel not in committed
-                and m is not None
-                and (not self._is_local() or _pid_alive(int(m.group(1))))
-            ):
-                # a writer's in-flight stage, not an orphan. Pid liveness
-                # is host-local, so on a REMOTE (shared) warehouse every
-                # never-referenced stage is protected unconditionally —
-                # deleting a live remote writer's stage would corrupt the
-                # commit it is about to publish.
-                continue
+            if rel not in committed and m is not None:
+                if fence is not None and m.group(2) is not None:
+                    # epoch-attributed stage: protected while its epoch is
+                    # at/above the fence (a live writer's in-flight commit);
+                    # below it the writer is fenced — collectable anywhere
+                    if int(m.group(2)) >= fence:
+                        continue
+                elif not self._is_local() or _pid_alive(int(m.group(1))):
+                    # pid attribution is host-local, so without a catalog a
+                    # REMOTE (shared) warehouse protects every never-
+                    # referenced stage unconditionally — deleting a live
+                    # remote writer's stage would corrupt the commit it is
+                    # about to publish.
+                    continue
             if faults.active():
                 faults.maybe_fire_path(full)
             try:
@@ -671,12 +802,15 @@ class LakehouseTable:
         torn `.tmp-<pid>-*.json` manifest temps with dead pids. Files the
         naming scheme cannot attribute (foreign files, pre-pid-format
         parts) are never touched — the same never-touch-foreign contract
-        as spill.sweep_orphans. Pid liveness is host-local, so on a
-        REMOTE (shared) warehouse the sweep is a no-op — a live writer on
-        another host would read as dead and lose its in-flight stage;
-        remote deployments clean orphans through vacuum's referenced-set
-        path instead. Returns the number of files removed."""
-        if not self._is_local():
+        as spill.sweep_orphans. Pid liveness is host-local, so WITHOUT a
+        catalog a REMOTE (shared) warehouse sweep is a no-op — a live
+        writer on another host would read as dead and lose its in-flight
+        stage. With `engine.lake_catalog` configured, epoch-stamped
+        stages below the table's fence are sweepable on ANY store (their
+        writers can never publish), which is how remote deployments get
+        crash hygiene back. Returns the number of files removed."""
+        fence = self.catalog.read_fence(self) if self.catalog else None
+        if not self._is_local() and fence is None:
             return 0
         referenced = self._all_referenced_files()
         removed = 0
@@ -693,9 +827,17 @@ class LakehouseTable:
                 continue
             if posixpath.join(_DATA_DIR, base) in referenced:
                 continue
-            pid = int(m.group(1))
-            if pid == os.getpid() or _pid_alive(pid):
-                continue
+            if fence is not None and m.group(2) is not None:
+                # fence attribution works cross-host: below the fence the
+                # writer is refused at publish, so its stage is debris
+                if int(m.group(2)) >= fence:
+                    continue
+            elif not self._is_local():
+                continue  # unattributable remotely without an epoch
+            else:
+                pid = int(m.group(1))
+                if pid == os.getpid() or _pid_alive(pid):
+                    continue
             try:
                 self.fs.rm_file(posixpath.join(self.data_dir, base))
                 removed += 1
@@ -711,6 +853,11 @@ class LakehouseTable:
         for base in man_names:
             m = _TMP_MANIFEST_RE.match(base)
             if m is None:
+                continue
+            if not self._is_local():
+                # tmp manifests carry no epoch: pid attribution only, and
+                # only where pids mean something (they are tiny debris on
+                # remote stores, never a correctness hazard)
                 continue
             pid = int(m.group(1))
             if pid == os.getpid() or _pid_alive(pid):

@@ -81,7 +81,8 @@ class MemorySampler:
 
     def __init__(self, interval_s: float | None = None,
                  watermark_bytes: int | None = None, on_watermark=None,
-                 tracer=None, query=None, heartbeat_s: float | None = None):
+                 tracer=None, query=None, heartbeat_s: float | None = None,
+                 on_heartbeat=None):
         if interval_s is None:
             interval_s = (
                 float(os.environ.get("NDS_TRACE_MEM_INTERVAL_MS", "50")) / 1000
@@ -101,6 +102,11 @@ class MemorySampler:
         # at most every `heartbeat_s`; tracer None disables it
         self.tracer = tracer
         self.query = query
+        # per-beat liveness work beyond the beacon (e.g. report.py renews
+        # the session's lakehouse reader leases here, so a statement
+        # outliving the lease TTL keeps its snapshot vacuum-safe); runs
+        # on the sampler thread even when no tracer is attached
+        self.on_heartbeat = on_heartbeat
         if heartbeat_s is None:
             heartbeat_s = (
                 float(os.environ.get("NDS_HEARTBEAT_INTERVAL_MS", "1000"))
@@ -150,10 +156,20 @@ class MemorySampler:
                     self.on_watermark(r)
                 except Exception:
                     pass  # pre-emption must never take the query down
-        if self.tracer is not None and self.heartbeat_s:
+        if (
+            self.heartbeat_s
+            and (self.tracer is not None or self.on_heartbeat is not None)
+        ):
             now = time.monotonic()
             if self._last_hb is None or now - self._last_hb >= self.heartbeat_s:
                 self._last_hb = now
+                if self.on_heartbeat is not None:
+                    try:
+                        self.on_heartbeat()
+                    except Exception:
+                        pass  # beat work must never take the query down
+                if self.tracer is None:
+                    return
                 r = v if self.source == "rss" else rss_bytes()
                 try:
                     self.tracer.emit(
@@ -177,8 +193,13 @@ class MemorySampler:
     def __enter__(self):
         self._t0 = time.monotonic()
         # the thread also runs with no readable memory signal when a
-        # tracer wants heartbeats: the beacon is about liveness, not bytes
-        if self._read is not None or self.tracer is not None:
+        # tracer wants heartbeats (or beat work is registered): the
+        # beacon is about liveness, not bytes
+        if (
+            self._read is not None
+            or self.tracer is not None
+            or self.on_heartbeat is not None
+        ):
             self._sample()
             self._thread = threading.Thread(
                 target=self._loop, name="nds-obs-memwatch", daemon=True

@@ -76,6 +76,9 @@ METRIC_KINDS = {
     "nds_lake_commit_attempts_total": "lake_commit",
     "nds_lake_vacuum_total": "lake_vacuum",
     "nds_lake_vacuum_files_total": "lake_vacuum",
+    "nds_catalog_commit_total": "catalog_commit",
+    "nds_catalog_commit_ms_total": "catalog_commit",
+    "nds_catalog_lease_total": "catalog_lease",
     "nds_fault_injected_total": "fault_injected",
     "nds_ladder_rung_total": "ladder_rung",
     "nds_watchdog_fire_total": "watchdog_fire",
@@ -520,6 +523,54 @@ class MetricsSink:
             "nds_lake_vacuum_files_total", int(ev.get("files_removed") or 0)
         )
 
+    def _catalog_status_locked(self, ev):
+        """The /statusz `catalog` section (caller holds _slock): scalar
+        tallies only, so status_snapshot's one-level dict copy suffices."""
+        cat = self._status.setdefault("catalog", {
+            "backend": None, "commits": 0, "conflicts": 0, "fenced": 0,
+            "rolled_back": 0, "unreachable": 0, "expired": 0,
+            "lease_ops": 0, "fence": None, "last_table": None,
+            "last_version": None, "last_ts_ms": None,
+        })
+        cat["backend"] = ev.get("backend")
+        cat["last_ts_ms"] = ev.get("ts")
+        return cat
+
+    def _h_catalog_commit(self, ev):
+        outcome = str(ev.get("outcome"))
+        backend = str(ev.get("backend"))
+        self.registry.inc(
+            "nds_catalog_commit_total", backend=backend, outcome=outcome
+        )
+        if ev.get("dur_ms") is not None:
+            self.registry.inc(
+                "nds_catalog_commit_ms_total", float(ev["dur_ms"]),
+                backend=backend,
+            )
+        with self._slock:
+            cat = self._catalog_status_locked(ev)
+            key = {
+                "ok": "commits", "conflict": "conflicts",
+                "fenced": "fenced", "rolled_back": "rolled_back",
+                "unreachable": "unreachable", "expired": "expired",
+            }.get(outcome)
+            if key:
+                cat[key] += 1
+            cat["last_table"] = ev.get("table")
+            if outcome == "ok":
+                cat["last_version"] = ev.get("version")
+
+    def _h_catalog_lease(self, ev):
+        self.registry.inc(
+            "nds_catalog_lease_total",
+            op=str(ev.get("op")), outcome=str(ev.get("outcome")),
+        )
+        with self._slock:
+            cat = self._catalog_status_locked(ev)
+            cat["lease_ops"] += 1
+            if ev.get("fence") is not None:
+                cat["fence"] = ev.get("fence")
+
     def _h_fault_injected(self, ev):
         self.registry.inc(
             "nds_fault_injected_total", kind=str(ev.get("fault_kind"))
@@ -740,6 +791,8 @@ _HANDLERS = {
     "spill": MetricsSink._h_spill,
     "lake_commit": MetricsSink._h_lake_commit,
     "lake_vacuum": MetricsSink._h_lake_vacuum,
+    "catalog_commit": MetricsSink._h_catalog_commit,
+    "catalog_lease": MetricsSink._h_catalog_lease,
     "fault_injected": MetricsSink._h_fault_injected,
     "ladder_rung": MetricsSink._h_ladder_rung,
     "watchdog_fire": MetricsSink._h_watchdog_fire,

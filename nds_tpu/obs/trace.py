@@ -153,6 +153,17 @@ EVENT_SCHEMA = {
     # them — the vacuum safety contract made visible
     "lake_vacuum": ("table", "files_removed", "manifests_removed",
                     "files_leased"),
+    # one fleet-catalog commit arbitration (lakehouse/catalog.py): outcome
+    # is ok | conflict | fenced | unreachable | expired (a slow
+    # coordinator refusing a publish past the client's deadline) |
+    # rolled_back (coordinator WAL recovery). Optional: dur_ms, txid,
+    # epoch — the cross-host half of lake_commit's story (a table-level
+    # lake_commit may cover several catalog_commit attempts)
+    "catalog_commit": ("table", "backend", "version", "outcome"),
+    # one fleet-catalog lease/fence operation: op is acquire | renew |
+    # release | sweep | writer_register | fence_bump. Optional: table,
+    # version, epoch, fence, live_writers, removed
+    "catalog_lease": ("op", "backend", "outcome"),
     # one serve-mode request outcome (nds_tpu/serve/service.py): status is
     # completed | failed | rejected | shed | draining, http_status the
     # wire answer. Optional: request_id, query, verdict (the admission

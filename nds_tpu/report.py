@@ -653,6 +653,26 @@ class BenchReport:
                     f"blocked-union window shrunk pre-emptively"
                 )
 
+        def _renew_lake_leases():
+            # heartbeat-cadence lease renewal: a statement outliving
+            # engine.lake_lease_ttl_s (a slow SF100-scale scan) must not
+            # have its pinned snapshot vacuumed mid-read — before this,
+            # leases only renewed on re-resolution
+            cat = getattr(self.session, "catalog", None)
+            if cat is not None and hasattr(cat, "renew_lake_leases"):
+                cat.renew_lake_leases()
+
+        # arm renewal only when the session actually serves lakehouse
+        # tables: a parquet/arrow-only session must keep the historical
+        # sampler-off fast path (no thread per statement)
+        _cat = getattr(self.session, "catalog", None)
+        renews_leases = (
+            hasattr(_cat, "renew_lake_leases")
+            and any(
+                getattr(e, "fmt", None) == "lakehouse"
+                for e in getattr(_cat, "entries", {}).values()
+            )
+        )
         sampler = (
             MemorySampler(
                 watermark_bytes=watermark or None,
@@ -663,8 +683,9 @@ class BenchReport:
                 # attempt stays visible on /statusz and in the log tail
                 tracer=self.tracer,
                 query=name,
+                on_heartbeat=_renew_lake_leases if renews_leases else None,
             )
-            if self.tracer is not None or watermark
+            if self.tracer is not None or watermark or renews_leases
             else None
         )
         if self.sink is not None:

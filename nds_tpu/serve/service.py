@@ -242,6 +242,12 @@ class QueryService:
         self._writer_lock = threading.Lock()
         self._state_lock = threading.Lock()
         self._in_flight = 0
+        self._active_rids = set()
+        # /reload lease hygiene: [(rids-still-running-at-reload, lease
+        # ids dropped by that reload)] — each batch releases when the
+        # LAST of its in-flight statements finishes, instead of
+        # abandoning the leases to TTL expiry (the PR-12 leak bound)
+        self._deferred_leases = []
         self._tenant_in_flight = {}
         self.draining = False
         self.started_ts_ms = int(time.time() * 1000)
@@ -333,7 +339,7 @@ class QueryService:
             **fields,
         )
 
-    def _enter(self, tenant):
+    def _enter(self, tenant, rid=None):
         """Claim an admission slot (semaphore + per-tenant cap) or raise
         _ShedError. The semaphore wait is bounded so an overloaded
         endpoint answers 429 instead of stacking blocked client threads.
@@ -372,6 +378,8 @@ class QueryService:
                     "service is draining", status=503, label="draining"
                 )
             self._in_flight += 1
+            if rid is not None:
+                self._active_rids.add(rid)
 
     def _drop_tenant_slot(self, tenant):
         with self._state_lock:
@@ -384,9 +392,29 @@ class QueryService:
         else:
             self._tenant_in_flight[tenant] = n
 
-    def _leave(self, tenant):
+    def _leave(self, tenant, rid=None):
+        release_now = []
         with self._state_lock:
             self._in_flight -= 1
+            if rid is not None:
+                self._active_rids.discard(rid)
+                # /reload lease hygiene: a dropped pin's lease batch
+                # releases once the last statement that was in flight at
+                # reload time finishes (it may still be scanning the
+                # pinned snapshot's files until then)
+                kept = []
+                for rids, lease_ids in self._deferred_leases:
+                    rids &= self._active_rids
+                    if rids:
+                        kept.append((rids, lease_ids))
+                    else:
+                        release_now.extend(lease_ids)
+                self._deferred_leases = kept
+        if release_now:
+            from ..lakehouse.leases import LEASES
+
+            for lid in release_now:
+                LEASES.release(lid)
         self._drop_tenant_slot(tenant)
         self._admission.release()
 
@@ -445,7 +473,7 @@ class QueryService:
             # admission fault site (io/oom/hang/crash injectable): an
             # injected failure here sheds the request, never the server
             faults.maybe_fire("serve:admit")
-            self._enter(tenant)
+            self._enter(tenant, rid)
         except _ShedError as exc:
             return self._shed_reply(
                 rid, tenant, t0, exc.reason, status=exc.status,
@@ -461,7 +489,7 @@ class QueryService:
                 payload, tenant, rid, t0, sql_text, qlabel
             )
         finally:
-            self._leave(tenant)
+            self._leave(tenant, rid)
 
     def _classify_statements(self, sql_text):
         stmts = parse_script(sql_text)
@@ -725,21 +753,41 @@ class QueryService:
             sessions.append(self.writer_session)
         if self._reload_fn is not None:
             reloaded["tables"] = self._reload_fn()
+        dropped = []
         for s in sessions:
             s._catalog_changed()  # plan/join-order caches may be stale
             for e in s.catalog.entries.values():
                 e.device_cols = {}
                 e.nrows = None
                 e.pk_verified = None
-                # drop the pin WITHOUT releasing its reader lease
+                # drop the pin WITHOUT releasing its reader lease here
                 # (catalog.invalidate would): an in-flight statement may
                 # still be scanning the pinned snapshot's files, and
                 # releasing mid-scan would expose them to a concurrent
-                # vacuum. The orphaned lease expires via its TTL — the
-                # lease table's documented leak bound.
+                # vacuum. The lease is released when the LAST statement
+                # that was in flight at this reload finishes (below);
+                # TTL expiry remains the crash backstop.
                 e.pinned_version = None
                 e.pinned_snapshot = None
-                e.lease_id = None
+                if e.lease_id is not None:
+                    dropped.append(e.lease_id)
+                    e.lease_id = None
+        if dropped:
+            release_now = []
+            with self._state_lock:
+                if self._active_rids:
+                    self._deferred_leases.append(
+                        (set(self._active_rids), dropped)
+                    )
+                else:
+                    release_now = dropped
+            if release_now:
+                from ..lakehouse.leases import LEASES
+
+                for lid in release_now:
+                    LEASES.release(lid)
+            reloaded["leases_dropped"] = len(dropped)
+            reloaded["leases_deferred"] = 0 if release_now else len(dropped)
         reloaded["sessions"] = len(sessions)
         return self._reply(200, reloaded)
 

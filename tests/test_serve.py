@@ -709,6 +709,59 @@ def test_reload_drops_pins_and_caches(tmp_path):
     service.close()
 
 
+def test_reload_releases_dropped_lease_after_last_in_flight(tmp_path):
+    """Satellite (PR-12 leftover): a /reload with statements in flight
+    keeps the dropped pin's reader lease alive while they run, then
+    releases it when the LAST of them finishes — instead of abandoning
+    it to the 300s TTL. With the pool idle, the release is immediate."""
+    from nds_tpu.lakehouse.leases import LEASES
+
+    path = _mini_lake(tmp_path, rows=8)
+    root = LakehouseTable(path).root
+    service, port, session = _make_service(lake_path=path)
+    baseline = LEASES.live_count(root)
+    q = "select k, count(*) c from fact group by k order by k"
+
+    # idle reload: pin's lease released on the spot, not TTL-abandoned
+    _post(port, {"sql": q})
+    assert LEASES.live_count(root) == baseline + 1
+    status, body, _ = _post(port, {}, path="/reload")
+    assert status == 200 and body["leases_dropped"] == 1
+    assert body["leases_deferred"] == 0
+    assert LEASES.live_count(root) == baseline
+
+    # reload WITH a statement in flight: deferred until it finishes
+    faults.install("hang:serve:exec:1.5")
+    box = {}
+
+    def request():
+        box["resp"] = _post(port, {"sql": q})
+
+    t = threading.Thread(target=request, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 10
+    while service.in_flight() == 0 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert service.in_flight() == 1
+    dropped_lid = session.catalog.entries["fact"].lease_id
+    assert dropped_lid is not None
+    status, body, _ = _post(port, {}, path="/reload")
+    assert status == 200 and body["leases_deferred"] == body["leases_dropped"]
+    # NOT released yet: the in-flight statement may still be scanning
+    assert dropped_lid in LEASES._leases
+    t.join(30)
+    assert box["resp"][0] == 200
+    # released promptly once the last in-flight statement finished — the
+    # 300s TTL cannot explain this. (The entry may hold a FRESH pin from
+    # the statement's execution-time re-pin; only the dropped lease must
+    # be gone.)
+    deadline = time.monotonic() + 5
+    while dropped_lid in LEASES._leases and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert dropped_lid not in LEASES._leases
+    service.close()
+
+
 # ---------------------------------------------------------------------------
 # knob derivations
 # ---------------------------------------------------------------------------

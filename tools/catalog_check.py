@@ -1,0 +1,345 @@
+#!/usr/bin/env python
+"""Fleet-catalog chaos gate (ci/tier1-check).
+
+Three acceptance checks over REAL processes:
+
+1. **Multi-process commit convergence** — 3 writer processes x N commits
+   against one table converge to exactly 3xN applied appends with a
+   LINEAR version history (one winner per version, loser rebases, no
+   rows lost or doubled), for the legacy filesystem mode AND both
+   catalog backends (fs CAS, tcp coordinator subprocess).
+2. **Coordinator crash mid-commit** — the coordinator process is
+   SIGKILLed between its WAL intent and the manifest publish (hang fault
+   at `catalog:commit` opens the window); restart recovery rolls the
+   unacknowledged intent back, no committed version is lost, no manifest
+   is torn, and the retried transaction lands its rows exactly once.
+3. **Vacuum under a remote-host lease** — with `_is_local() == False`
+   (remote-warehouse mode) vacuum never removes a file a lease from
+   ANOTHER host covers, and epoch fencing collects a fenced zombie's
+   stage without pid liveness.
+
+Usage: python tools/catalog_check.py [--keep]
+"""
+
+import argparse
+import json
+import os
+import posixpath
+import re
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import pyarrow as pa  # noqa: E402
+
+from nds_tpu.lakehouse import catalog as C  # noqa: E402
+from nds_tpu.lakehouse.table import LakehouseTable  # noqa: E402
+
+WRITERS = 3
+COMMITS = 4
+
+_WRITER_SCRIPT = """
+import os, sys
+sys.path.insert(0, {repo!r})
+import pyarrow as pa
+from nds_tpu.lakehouse.table import LakehouseTable
+t = LakehouseTable({path!r})
+base = int(sys.argv[1])
+for i in range({commits}):
+    t.append(pa.table({{"a": pa.array([base + i])}}))
+"""
+
+
+def _ints(*vals):
+    return pa.table({"a": pa.array(list(vals), type=pa.int64())})
+
+
+def _vals(path):
+    return sorted(
+        x["a"] for x in LakehouseTable(path).dataset().to_table().to_pylist()
+    )
+
+
+def _versions(path):
+    return [v for v, _, _ in LakehouseTable(path).versions()]
+
+
+def _check(ok, label):
+    print(f"  {'OK ' if ok else 'FAIL'} {label}")
+    if not ok:
+        raise SystemExit(f"catalog_check: FAILED: {label}")
+
+
+def _env(**extra):
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "NDS_LAKE_COMMIT_RETRIES": "128",
+        "NDS_LAKE_COMMIT_BACKOFF": "0.005",
+    }
+    env.update(extra)
+    return env
+
+
+def _spawn_coordinator(warehouse, fault_spec=None):
+    """Start a REAL coordinator subprocess on an ephemeral port; returns
+    (proc, url)."""
+    env = _env(NDS_METRICS_HOST="127.0.0.1")
+    env.pop("NDS_FAULT_SPEC", None)
+    if fault_spec:
+        env["NDS_FAULT_SPEC"] = fault_spec
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "nds_tpu.cli.catalog", warehouse,
+         "--port", "0"],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+    deadline = time.monotonic() + 60
+    port = None
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        m = re.search(r"coordinating .* on [^:]+:(\d+)", line)
+        if m:
+            port = int(m.group(1))
+            break
+    if port is None:
+        proc.kill()
+        raise SystemExit("catalog_check: coordinator never announced a port")
+    return proc, f"http://127.0.0.1:{port}"
+
+
+def _run_writers(path, extra_env):
+    script = _WRITER_SCRIPT.format(repo=REPO, path=path, commits=COMMITS)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", script, str(1000 * (w + 1))],
+            env=_env(**extra_env), stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+        )
+        for w in range(WRITERS)
+    ]
+    for p in procs:
+        _out, err = p.communicate(timeout=300)
+        if p.returncode != 0:
+            raise SystemExit(
+                f"catalog_check: writer failed:\n{err.decode()[-3000:]}"
+            )
+
+
+def check_convergence(workdir):
+    """3 writer processes x N commits -> exactly 3xN appended rows, one
+    winner per version, linear history — every mode."""
+    for mode in ("off", "fs", "tcp"):
+        print(f"convergence [{mode}]: {WRITERS} procs x {COMMITS} commits")
+        wh = os.path.join(workdir, f"wh-{mode}")
+        os.makedirs(wh)
+        path = os.path.join(wh, "t")
+        LakehouseTable.create(path, _ints(0))
+        coord = None
+        try:
+            if mode == "tcp":
+                coord, url = _spawn_coordinator(wh)
+                extra = {"NDS_LAKE_CATALOG": url}
+            elif mode == "fs":
+                extra = {"NDS_LAKE_CATALOG": "fs"}
+            else:
+                extra = {"NDS_LAKE_CATALOG": ""}
+            _run_writers(path, extra)
+        finally:
+            if coord is not None:
+                coord.terminate()
+                coord.wait(timeout=30)
+        expected = sorted([0] + [
+            1000 * (w + 1) + i for w in range(WRITERS)
+            for i in range(COMMITS)
+        ])
+        _check(_vals(path) == expected,
+               f"{WRITERS * COMMITS} appends all applied exactly once")
+        _check(_versions(path) == list(range(1, WRITERS * COMMITS + 2)),
+               "version history is linear (one winner per version)")
+        # every manifest parses whole (no torn publish anywhere)
+        for v in _versions(path):
+            LakehouseTable(path).snapshot(v)
+        _check(True, "every manifest parses (no torn publish)")
+
+
+def check_crash_mid_commit(workdir):
+    """SIGKILL the coordinator between WAL intent and publish; restart
+    recovery must lose no committed version, tear no manifest, and the
+    retried transaction must land exactly once."""
+    print("coordinator crash mid-commit -> restart recovery")
+    wh = os.path.join(workdir, "wh-crash")
+    os.makedirs(wh)
+    path = os.path.join(wh, "t")
+    LakehouseTable.create(path, _ints(1))
+    # the hang fault holds the coordinator INSIDE the commit critical
+    # section (after the WAL intent, before the publish) long enough for
+    # a deterministic SIGKILL — a crash exactly mid-commit
+    coord, url = _spawn_coordinator(wh, fault_spec="hang:catalog:commit:60")
+    client_conf = {"engine.lake_catalog": url}
+    os.environ["NDS_LAKE_CATALOG_TIMEOUT_S"] = "3"
+    os.environ["NDS_LAKE_CATALOG_POLL_S"] = "0.5"
+    try:
+        t = LakehouseTable(path, conf=client_conf)
+        try:
+            t.append(_ints(2))
+            _check(False, "commit must not complete under the crash")
+        except Exception as exc:
+            from nds_tpu import faults
+
+            _check(faults.classify(exc) == faults.IO_TRANSIENT,
+                   f"cut-off commit classified retryable ({type(exc).__name__})")
+    finally:
+        coord.send_signal(signal.SIGKILL)
+        coord.wait(timeout=30)
+        os.environ.pop("NDS_LAKE_CATALOG_TIMEOUT_S", None)
+        os.environ.pop("NDS_LAKE_CATALOG_POLL_S", None)
+    wal_dir = os.path.join(path, "_catalog", "wal")
+    wal = [f for f in os.listdir(wal_dir) if f.endswith(".json")]
+    _check(len(wal) == 1, "WAL intent survived the kill")
+    _check(_versions(path) == [1],
+           "no manifest published by the killed commit (head intact)")
+    # restart: recovery rolls the unacknowledged intent back
+    rec = subprocess.run(
+        [sys.executable, "-m", "nds_tpu.cli.catalog", wh, "--port", "0",
+         "--recover_only"],
+        env=_env(), cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    if rec.returncode != 0:
+        raise SystemExit(f"catalog_check: recovery failed:\n{rec.stdout}"
+                         f"\n{rec.stderr}")
+    _check("rolled back" in rec.stdout, "recovery reported the rollback")
+    wal = [f for f in os.listdir(wal_dir) if f.endswith(".json")]
+    _check(wal == [], "WAL empty after recovery")
+    # the ladder-style retry: a fresh coordinator serves the re-run
+    coord2, url2 = _spawn_coordinator(wh)
+    try:
+        C.reset_clients()
+        LakehouseTable(path, conf={"engine.lake_catalog": url2}).append(
+            _ints(2)
+        )
+    finally:
+        coord2.terminate()
+        coord2.wait(timeout=30)
+    _check(_vals(path) == [1, 2], "retried transaction applied exactly once")
+    _check(_versions(path) == [1, 2], "history linear after recovery")
+    for v in _versions(path):
+        m = LakehouseTable(path)._manifest(v)
+        json.dumps(m)  # parses + re-serializes whole
+    _check(True, "no torn manifest after kill + recovery")
+
+
+def check_remote_lease_vacuum(workdir):
+    """Remote-warehouse mode: vacuum must never remove files under
+    another host's lease, and must collect a fenced zombie's stage."""
+    print("remote-mode vacuum: cross-host lease + zombie fencing")
+    wh = os.path.join(workdir, "wh-remote")
+    os.makedirs(wh)
+    path = os.path.join(wh, "t")
+    os.environ["NDS_LAKE_CATALOG"] = "fs"
+    C.reset_clients()
+    try:
+        LakehouseTable.create(path, _ints(1, 2, 3))
+        lt = LakehouseTable(path)
+        snap1 = lt.snapshot(1)
+        # "another host": a lease that exists ONLY as catalog state (this
+        # process's in-memory lease table never sees it — exactly what a
+        # second host looks like)
+        other_host = subprocess.run(
+            [sys.executable, "-c", (
+                f"import sys; sys.path.insert(0, {REPO!r})\n"
+                f"from nds_tpu.lakehouse import catalog as C\n"
+                f"ref = C._TableRef({path!r})\n"
+                f"lease = C.FsCatalog().lease_acquire("
+                f"ref, 1, {snap1.rel_files!r}, 120)\n"
+                f"print('LEASE', lease.lease_id)\n"
+            )],
+            env=_env(), capture_output=True, text=True, timeout=120,
+        )
+        if other_host.returncode != 0:
+            raise SystemExit(f"catalog_check: lease process failed:\n"
+                             f"{other_host.stderr[-2000:]}")
+        lease_id = other_host.stdout.split("LEASE", 1)[1].strip()
+        # a zombie writer's never-referenced stage (expired writer lease)
+        os.environ["NDS_LAKE_WRITER_TTL_S"] = "0.05"
+        zombie = LakehouseTable(path)
+        staged = zombie._stage(_ints(99))
+        stage_base = posixpath.basename(staged[0][0])
+        time.sleep(0.2)
+        os.environ.pop("NDS_LAKE_WRITER_TTL_S")
+        LakehouseTable(path).replace(_ints(9))  # v2: v1 collectable-but-leased
+        orig = LakehouseTable._is_local
+        LakehouseTable._is_local = lambda self: False
+        try:
+            # force the file-layer check: expire v1's manifest first
+            os.unlink(os.path.join(path, "_manifests", "v000001.json"))
+            res = LakehouseTable(path).vacuum(retain_last=1)
+            survivors = set(os.listdir(os.path.join(path, "data")))
+            _check(
+                all(posixpath.basename(f) in survivors
+                    for f in snap1.rel_files),
+                "files under the other host's lease survived vacuum",
+            )
+            _check(res["files_leased"] >= 1, "vacuum counted the kept leased files")
+            _check(stage_base not in survivors,
+                   "fenced zombie's stage collected without pid liveness")
+            # the zombie can never publish the deleted stage
+            try:
+                zombie._commit(staged, "append")
+                _check(False, "fenced zombie must not publish")
+            except Exception as exc:
+                from nds_tpu import faults
+
+                _check(faults.classify(exc) == faults.COMMIT_CONFLICT,
+                       "fenced publish refused, classified commit_conflict")
+            # released -> collectable
+            rel = subprocess.run(
+                [sys.executable, "-c", (
+                    f"import sys; sys.path.insert(0, {REPO!r})\n"
+                    f"from nds_tpu.lakehouse import catalog as C\n"
+                    f"ref = C._TableRef({path!r})\n"
+                    f"print(C.FsCatalog().lease_release(ref, {lease_id!r}))\n"
+                )],
+                env=_env(), capture_output=True, text=True, timeout=120,
+            )
+            _check("True" in rel.stdout, "other host released its lease")
+            res2 = LakehouseTable(path).vacuum(retain_last=1)
+            _check(res2["files_removed"] >= 1,
+                   "released files collected on the next vacuum")
+        finally:
+            LakehouseTable._is_local = orig
+        _check(_vals(path) == [9], "committed data intact throughout")
+    finally:
+        os.environ.pop("NDS_LAKE_CATALOG", None)
+        C.reset_clients()
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the scratch dir for inspection")
+    args = ap.parse_args()
+    workdir = tempfile.mkdtemp(prefix="nds-catalog-check-")
+    t0 = time.perf_counter()
+    try:
+        check_convergence(workdir)
+        check_crash_mid_commit(workdir)
+        check_remote_lease_vacuum(workdir)
+    finally:
+        if args.keep:
+            print(f"catalog_check: scratch kept at {workdir}")
+        else:
+            shutil.rmtree(workdir, ignore_errors=True)
+    print(f"catalog_check: OK ({time.perf_counter() - t0:.1f}s)")
+
+
+if __name__ == "__main__":
+    main()
