@@ -748,6 +748,13 @@ class PlanBudgeter:
 
     def _est_scan(self, node: P.Scan) -> NodeEstimate:
         rows = self.stats.table_rows(node.table)
+        # zone-map surviving-row bound (Session._prune_lake_scans): a HARD
+        # upper bound from the pinned manifest's per-file stats — tighter
+        # than any table-level estimate whenever pruning fired, and a
+        # usable size even for tables the stats layer knows nothing about
+        prune_rows = getattr(node, "prune_rows", None)
+        if prune_rows is not None:
+            rows = prune_rows if rows is None else min(rows, prune_rows)
         if rows is None:
             self.unknown_tables.append(node.table)
             rows = 0

@@ -78,6 +78,16 @@ Every rule below encodes a bug this codebase actually shipped (and fixed):
                           every mutation outside a held session lock
                           (`with session.cache_lock:`) is a latent race.
                           Scope: everywhere.
+  scan-path-listing       the PR-16 zone-map invariant: the scan path
+                          discovers table files ONLY through the pinned
+                          manifest (TableSnapshot.files()/file_stats()),
+                          never by glob/listdir of data directories — a
+                          raw listing sees uncommitted staged files,
+                          vacuum-doomed debris, and files from other
+                          snapshot versions, and silently bypasses
+                          zone-map pruning. Scope: engine/session.py,
+                          engine/exec.py (the modules that resolve a
+                          Scan node to files).
 
 Pragma: append `# nds-lint: disable=<rule>[,<rule>...]` (with a
 justification!) on the offending line or the line directly above to
@@ -783,6 +793,42 @@ def _r_cache_lock_discipline(tree, relpath):
                 f"(`with session.cache_lock:`); exec/join-order/pallas/"
                 f"plan caches go multi-tenant under the serve work and "
                 f"every unguarded mutation is a latent race"
+            )))
+    return out
+
+
+#: directory-listing calls the scan path must not make: file discovery
+#: goes through the pinned manifest (TableSnapshot.files()/dataset()),
+#: never the filesystem — a raw listing sees uncommitted staged files,
+#: vacuum-doomed debris, and files from OTHER snapshot versions
+_LISTING_ATTRS = ("glob", "iglob", "listdir", "scandir", "walk")
+
+
+@_rule("scan-path-listing", lambda rp: rp in ("engine/session.py",
+                                              "engine/exec.py"))
+def _r_scan_path_listing(tree, relpath):
+    out = []
+    from_imports = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module in ("glob", "os"):
+            for a in node.names:
+                if a.name in _LISTING_ATTRS:
+                    from_imports.add(a.asname or a.name)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        hit = (
+            isinstance(f, ast.Attribute) and f.attr in _LISTING_ATTRS
+            and isinstance(f.value, ast.Name) and f.value.id in ("glob", "os")
+        ) or (isinstance(f, ast.Name) and f.id in from_imports)
+        if hit:
+            out.append((node.lineno, (
+                "filesystem listing on the scan path; table-file discovery "
+                "must go through the pinned manifest / zone-map API "
+                "(TableSnapshot.files()/file_stats()) — a raw glob/listdir "
+                "sees uncommitted staged files, vacuum debris, and other "
+                "snapshot versions' files"
             )))
     return out
 

@@ -2,7 +2,7 @@
 
     python -m nds_tpu.cli.maintenance <warehouse_path> <refresh_data_path>
         <time_log> [--maintenance_queries LF_CS,DF_CS] [--property_file F]
-        [--json_summary_folder DIR] [--floats] [--vacuum]
+        [--json_summary_folder DIR] [--floats] [--vacuum] [--optimize]
 
 Maintenance-under-load mode (`full_bench`'s opt-in phase): pass
 `--under_load_stream <query_N.sql>` and the DM functions run in a racing
@@ -52,6 +52,13 @@ def main(argv=None):
         "the refresh functions (reader-lease safe)",
     )
     parser.add_argument(
+        "--optimize",
+        action="store_true",
+        help="compact small data files after the refresh functions "
+        "(bin-pack toward engine.lake_compact_target_bytes, zone maps "
+        "regenerated; snapshot-isolated from concurrent readers)",
+    )
+    parser.add_argument(
         "--under_load_stream",
         help="query stream file to run CONCURRENTLY with the refresh "
         "functions (maintenance-under-load mode)",
@@ -88,6 +95,7 @@ def main(argv=None):
         spec_queries=args.maintenance_queries,
         use_decimal=not args.floats,
         vacuum_after=args.vacuum,
+        optimize_after=args.optimize,
     )
 
 

@@ -3,9 +3,11 @@
     python -m nds_tpu.cli.transcode <input_prefix> <output_prefix> <report_file>
         [--output_format parquet|csv] [--output_mode overwrite|...]
         [--tables t1,t2] [--floats] [--update] [--compression codec]
+        [--workers N] [--resume]
 """
 
 import argparse
+import os
 
 from ..check import check_version
 from ..transcode import transcode
@@ -53,6 +55,19 @@ def main(argv=None):
     parser.add_argument(
         "--compression",
         help="compression codec, e.g. snappy (default), zstd, gzip, none",
+    )
+    parser.add_argument(
+        "--workers",
+        type=int,
+        default=int(os.environ.get("NDS_TRANSCODE_WORKERS", "1")),
+        help="decode worker processes for lakehouse ingest "
+             "(default NDS_TRANSCODE_WORKERS or 1; other formats ignore it)",
+    )
+    parser.add_argument(
+        "--resume",
+        action="store_true",
+        help="lakehouse only: continue a killed ingest — replay chunks "
+             "missing from the manifest's ingest ledger, skip the rest",
     )
     args = parser.parse_args(argv)
     transcode(args)

@@ -325,8 +325,11 @@ class Executor:
         # — threading it here keeps the scan on ITS statement's snapshot
         # even when another stream sharing this session has re-pinned the
         # catalog entry, and after a device-OOM recovery wiped the cache
+        # lake_files: the zone-map pruned file subset
+        # (Session._prune_lake_scans) — the load opens only surviving files
         t = self.catalog.load(
-            node.table, node.columns, lake_version=node.lake_version
+            node.table, node.columns, lake_version=node.lake_version,
+            lake_files=node.lake_files,
         )
         uk = t.unique_key
         if uk is not None:

@@ -33,6 +33,13 @@ class Scan(PlanNode):
     # dataclass field on purpose — it participates in plan.fingerprint, so
     # plan-cache entries can never alias across snapshot versions.
     lake_version: int = None
+    # zone-map pruning (Session._prune_lake_scans): the pinned manifest's
+    # files that MAY match this scan's bound predicate (None = read all),
+    # and the surviving-row upper bound the budgeter consumes. Dataclass
+    # fields like lake_version — they participate in fingerprint, so a
+    # pruned plan can never alias an unpruned one in the plan cache.
+    lake_files: tuple = None
+    prune_rows: int = None
 
 
 @dataclass

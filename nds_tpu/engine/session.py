@@ -213,7 +213,7 @@ class Catalog:
             tuple(Field(f.name, _infer_dtype(f.type)) for f in at)
         )
 
-    def _dataset(self, e: _Entry, snapshot=None):
+    def _dataset(self, e: _Entry, snapshot=None, files=None):
         # hive partitioning discovery: the transcode phase writes fact tables
         # as <date_sk>=<value>/ directories; declare the partition field type
         # from the table schema so keys round-trip with the right dtype
@@ -230,7 +230,10 @@ class Catalog:
             snap = snapshot if snapshot is not None else e.pinned_snapshot
             if snap is None:
                 snap = LakehouseTable(e.path).snapshot()
-            return snap.dataset()
+            # `files`: a zone-map pruned subset of the snapshot's file
+            # list (Scan.lake_files) — the point where pruning becomes
+            # skipped IO rather than a plan annotation
+            return snap.dataset(files=files)
         part = "hive"
         fmt = e.fmt
         if e.schema is not None:
@@ -333,7 +336,8 @@ class Catalog:
 
         return _hold()
 
-    def load(self, name, columns=None, lake_version=None) -> Table:
+    def load(self, name, columns=None, lake_version=None,
+             lake_files=None) -> Table:
         """Load (a projection of) a table to device, caching per column so
         repeated queries over different column subsets never re-read or
         re-upload what is already in HBM.
@@ -342,7 +346,15 @@ class Catalog:
         (engine/exec.py threads it from Scan.lake_version). When another
         statement has since moved the entry's pin, the entry is re-pinned
         to the scan's version first — per-plan snapshot isolation even on
-        a session shared by concurrent streams."""
+        a session shared by concurrent streams.
+
+        `lake_files`: a zone-map pruned subset of the pinned snapshot's
+        file list (Scan.lake_files). Subset loads NEVER touch the entry's
+        device-column cache — cached columns are the FULL table's, and a
+        pruned read mixed into them would poison every later scan — so
+        they take the detached path: read exactly those files, serve the
+        plan directly (the same isolation shape as version-detached
+        reads)."""
         e = self.entries.get(name)
         if e is None:
             raise KeyError(f"unknown table {name}")
@@ -389,6 +401,7 @@ class Catalog:
         # it without touching the entry cache at all — cached columns
         # belong to the other pin now.
         snap = e.pinned_snapshot
+        subset = e.fmt == "lakehouse" and lake_files is not None
         detached = (
             e.fmt == "lakehouse"
             and lake_version is not None
@@ -410,8 +423,14 @@ class Catalog:
             lt.acquire_reader_lease(
                 snap, resolve_lease_ttl(self.session.conf)
             )
+        if subset and not columns:
+            # zero-projection pruned scan (count-style): the row count
+            # must come from the pruned subset, never the entry's cached
+            # full-table nrows
+            ds = self._dataset(e, snapshot=snap, files=list(lake_files))
+            return Table({}, ds.count_rows())
         missing = (
-            list(columns) if detached
+            list(columns) if detached or subset
             else [c for c in columns if c not in e.device_cols]
         )
         if missing:
@@ -419,9 +438,10 @@ class Catalog:
             def _load(cols_to_load):
                 arrow = e.arrow
                 if arrow is None:
-                    arrow = self._dataset(e, snapshot=snap).to_table(
-                        columns=cols_to_load
-                    )
+                    arrow = self._dataset(
+                        e, snapshot=snap,
+                        files=(list(lake_files) if subset else None),
+                    ).to_table(columns=cols_to_load)
                 else:
                     arrow = arrow.select(cols_to_load)
                 return self._to_device(name, arrow, e)
@@ -442,7 +462,7 @@ class Catalog:
                     f"task retry: device memory exhausted loading {name!r}; "
                     f"dropped cached tables and reloaded"
                 )
-            if detached or (
+            if detached or subset or (
                 snap is not None and e.pinned_snapshot is not snap
             ):
                 # detached up front, or a concurrent stream re-pinned the
@@ -1083,6 +1103,13 @@ class Session:
         plan = prune_columns(plan, self.catalog)
         if verify is not None and level == "all":
             verify(plan, "prune_columns")
+        # snapshot pin + zone-map pruning BEFORE the budgeter: pinning
+        # here (rather than around run_stmt, where it used to live) means
+        # pruning, budgeting and execution all see the SAME manifest
+        # version — no window for a concurrent commit to skew the stats
+        # the budget was modeled from
+        self._pin_lake_scans(plan)
+        self._prune_lake_scans(plan)
         P.mark_blocked_union_aggs(plan)
         if verify is not None and level == "all":
             verify(plan, "mark_blocked_union_aggs")
@@ -1138,6 +1165,56 @@ class Session:
                     n.lake_version = pinned[n.table]
         return plan
 
+    def _prune_lake_scans(self, plan):
+        """Zone-map file pruning: for each Filter directly over a pinned
+        lakehouse Scan, evaluate the filter's simple single-column
+        conjuncts against the pinned manifest's per-file stats and
+        annotate the Scan with the surviving file subset
+        (Scan.lake_files; exec threads it into catalog.load so pruned
+        files are never opened) and the surviving-row upper bound
+        (Scan.prune_rows; the budgeter clamps its scan estimate with
+        it). Purely an annotation pass — the filter still runs over
+        every surviving row, so a conservative zone map costs IO, never
+        correctness. `engine.lake_prune=off` disables it."""
+        if str(self.conf.get("engine.lake_prune", "on")).lower() == "off":
+            return plan
+        from ..lakehouse.zonemap import prune_files
+
+        for n in P.walk_plan(plan):
+            if not (
+                isinstance(n, P.Filter) and isinstance(n.child, P.Scan)
+            ):
+                continue
+            scan = n.child
+            if scan.lake_version is None:
+                continue
+            e = self.catalog.entries.get(scan.table)
+            snap = e.pinned_snapshot if e is not None else None
+            if snap is None or snap.version != scan.lake_version:
+                continue  # detached pin: skip rather than re-resolve
+            stats = snap.file_stats()
+            if not stats:
+                continue  # pre-stats manifest (back-compat): nothing known
+            preds = _zone_preds(n.predicate, scan.alias)
+            if not preds:
+                continue
+            t0 = _perf()
+            keep, pruned_rows = prune_files(snap.rel_files, stats, preds)
+            n_total = len(snap.rel_files)
+            if len(keep) < n_total:
+                scan.lake_files = tuple(keep)
+                total = snap.num_rows()
+                if total >= 0:
+                    scan.prune_rows = max(total - pruned_rows, 0)
+            if self.tracer is not None:
+                self.tracer.emit(
+                    "scan_prune", table=scan.table, files_total=n_total,
+                    files_pruned=n_total - len(keep),
+                    rows_bound=scan.prune_rows,
+                    dur_ms=round((_perf() - t0) * 1000.0, 3),
+                )
+        return plan
+
     def run_stmt(self, stmt) -> Optional[Result]:
         if isinstance(stmt, A.SelectStmt):
             binder = Binder(self.catalog)
@@ -1159,13 +1236,13 @@ class Session:
                         _faults.current_scope(),
                         lambda p=plan: P.explain(p),
                     )
-            return Result(self, self._pin_lake_scans(plan))
+            return Result(self, plan)
         if isinstance(stmt, A.CreateViewStmt):
             binder = Binder(self.catalog)
             plan = self._finish_plan(
                 binder.bind(stmt.query), binder.promotions
             )
-            arrow = Result(self, self._pin_lake_scans(plan)).collect()
+            arrow = Result(self, plan).collect()
             self.register_arrow(stmt.name, arrow)
             return None
         if isinstance(stmt, A.DropViewStmt):
@@ -1176,6 +1253,72 @@ class Session:
 
             return run_dml(self, stmt)
         raise TypeError(f"unsupported statement {type(stmt).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# Zone-map pruning: extract prunable conjuncts from a scan's filter
+# ---------------------------------------------------------------------------
+
+#: immutable operator-mirror lookup (literal-on-left comparisons flip);
+#: never mutated
+_ZONE_FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "="}  # nds-lint: disable=mutable-module-global
+
+
+def _zone_preds(pred, alias):
+    """Reduce a filter predicate to the conjuncts zone maps can act on,
+    as the plain tuples lakehouse/zonemap.py evaluates: column-vs-literal
+    comparisons, BETWEEN, IN lists and IS NOT NULL over THIS scan's
+    columns. Anything else (OR trees, expressions over the column,
+    NULL literals, negated forms) is simply not extracted — unextracted
+    conjuncts mean less pruning, never wrong pruning."""
+    prefix = alias + "."
+    out = []
+
+    def col(e):
+        if isinstance(e, E.Col) and e.name.startswith(prefix):
+            return e.name.split(".", 1)[1]
+        return None
+
+    def lit(e):
+        if isinstance(e, E.Lit) and e.value is not None:
+            return e.value
+        return None
+
+    def walk(e):
+        if isinstance(e, E.BinOp):
+            if e.op == "and":
+                walk(e.left)
+                walk(e.right)
+                return
+            if e.op in _ZONE_FLIP:
+                c, v = col(e.left), lit(e.right)
+                if c is not None and v is not None:
+                    out.append(("cmp", c, e.op, v))
+                    return
+                c, v = col(e.right), lit(e.left)
+                if c is not None and v is not None:
+                    out.append(("cmp", c, _ZONE_FLIP[e.op], v))
+            return
+        if isinstance(e, E.Between) and not e.negated:
+            c = col(e.operand)
+            lo, hi = lit(e.low), lit(e.high)
+            if c is not None and lo is not None and hi is not None:
+                out.append(("between", c, lo, hi))
+            return
+        if isinstance(e, E.InList) and not e.negated and e.values:
+            c = col(e.operand)
+            if c is not None:
+                vals = tuple(lit(v) for v in e.values)
+                if all(v is not None for v in vals):
+                    out.append(("in", c, vals))
+            return
+        if isinstance(e, E.UnaryOp) and e.op == "isnotnull":
+            c = col(e.operand)
+            if c is not None:
+                out.append(("notnull", c))
+
+    walk(pred)
+    return out
 
 
 # ---------------------------------------------------------------------------

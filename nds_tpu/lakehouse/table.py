@@ -75,6 +75,7 @@ import pyarrow.parquet as pq
 from ..io.fs import get_fs, put_if_absent
 from .catalog import CatalogFencedError, resolve_catalog, resolve_writer_ttl
 from .leases import LEASES
+from .zonemap import StatsAccumulator
 
 _MANIFEST_DIR = "_manifests"
 _DATA_DIR = "data"
@@ -118,6 +119,17 @@ class CommitConflictError(LakehouseError):
     exactly that with jittered backoff."""
 
 
+class _ChunkAlreadyIngested(LakehouseError):
+    """Internal commit-point signal: every chunk id this transaction
+    carries is already in the head's ingest ledger, so publishing would
+    duplicate rows. Carries the head version; callers discard their
+    staged files and treat the chunk as done (exactly-once)."""
+
+    def __init__(self, version: int):
+        super().__init__(f"chunk already ingested at v{version}")
+        self.version = int(version)
+
+
 def resolve_commit_retries(conf: dict | None = None) -> int:
     v = None
     if conf:
@@ -140,6 +152,37 @@ def commit_backoff_base() -> float:
         return max(float(os.environ.get(COMMIT_BACKOFF_ENV, "0.05")), 0.0)
     except ValueError:
         return 0.05
+
+
+def resolve_compact_target_bytes(conf: dict | None = None) -> int:
+    """Compaction size goal: files below this are rewrite candidates and
+    groups are packed up to roughly this size (conf
+    `engine.lake_compact_target_bytes` / env
+    NDS_LAKE_COMPACT_TARGET_BYTES, default 128 MiB)."""
+    v = None
+    if conf:
+        v = conf.get("engine.lake_compact_target_bytes")
+    if v is None:
+        v = os.environ.get("NDS_LAKE_COMPACT_TARGET_BYTES")
+    try:
+        return max(int(v), 1) if v not in (None, "") else 128 << 20
+    except (TypeError, ValueError):
+        return 128 << 20
+
+
+def resolve_compact_min_files(conf: dict | None = None) -> int:
+    """Minimum small-file count before a compaction rewrite is worth a
+    commit (conf `engine.lake_compact_min_files` / env
+    NDS_LAKE_COMPACT_MIN_FILES, default 4)."""
+    v = None
+    if conf:
+        v = conf.get("engine.lake_compact_min_files")
+    if v is None:
+        v = os.environ.get("NDS_LAKE_COMPACT_MIN_FILES")
+    try:
+        return max(int(v), 2) if v not in (None, "") else 4
+    except (TypeError, ValueError):
+        return 4
 
 
 def resolve_conflict_retries() -> int:
@@ -205,9 +248,32 @@ class TableSnapshot:
             )
         return None
 
-    def dataset(self) -> pads.Dataset:
-        files = self.files()
-        if not files:
+    def file_stats(self) -> dict:
+        """Per-file zone maps recorded at commit time:
+        {relpath: {"rows": n, "columns": {col: {"min","max","nulls"}}}}.
+        Empty for manifests written before the stats schema (back-compat:
+        a file absent from stats is simply never pruned)."""
+        return self.manifest.get("stats") or {}
+
+    def ingest_chunks(self) -> set:
+        """Chunk ids the ingest ledger records as committed — the
+        exactly-once resume checkpoint (see LakehouseTable.ingest_chunk)."""
+        return set(self.manifest.get("ingest_chunks") or [])
+
+    def dataset(self, files=None) -> pads.Dataset:
+        """Dataset over the snapshot's files — or, with `files` (an
+        iterable of manifest-relative paths, e.g. a zone-map pruned
+        subset), over exactly those files in manifest order."""
+        if files is not None:
+            subset = set(files)
+            paths = [
+                posixpath.join(self.table.root, f)
+                for f in self.manifest["files"]
+                if f in subset
+            ]
+        else:
+            paths = self.files()
+        if not paths:
             # empty snapshot: in-memory empty dataset over the stored schema
             schema = self.schema()
             if schema is None:
@@ -215,7 +281,7 @@ class TableSnapshot:
                     f"{self.table.path}: empty table with no schema"
                 )
             return pads.dataset(schema.empty_table())
-        return pads.dataset(files, format="parquet", filesystem=self.table.fs)
+        return pads.dataset(paths, format="parquet", filesystem=self.table.fs)
 
 
 class LakehouseTable:
@@ -408,8 +474,11 @@ class LakehouseTable:
 
     # -- writes ------------------------------------------------------------
     def _stage(self, batches, schema=None):
-        """Write data files; returns [(relpath, num_rows)]. Not yet visible.
-        File names embed this process's pid (crash-hygiene attribution)."""
+        """Write data files; returns [(relpath, num_rows, stats)] where
+        stats is the file's zone map ({"rows", "columns": {...min/max/
+        nulls...}}), computed from the same batch stream that built the
+        file — no second read. Not yet visible. File names embed this
+        process's pid (crash-hygiene attribution)."""
         from .. import faults
 
         if faults.active():
@@ -422,7 +491,7 @@ class LakehouseTable:
         writer = None
         out = None
         relpath = None
-        n_rows = 0
+        acc = StatsAccumulator()
         # with a catalog, staged names carry the writer's fencing epoch so
         # a vacuum on ANY host can attribute the stage (pids are host-local)
         epoch_tag = (
@@ -443,28 +512,54 @@ class LakehouseTable:
                         out, schema or b.schema, compression="snappy"
                     )
                 writer.write_batch(b)
-                n_rows += b.num_rows
+                acc.update(b)
         finally:
             if writer is not None:
                 writer.close()
             if out is not None:
                 out.close()
         if relpath is not None:
-            staged.append((relpath, n_rows))
+            staged.append((relpath, acc.rows, acc.finish()))
+        return staged
+
+    def stage_clustered(self, tbl: pa.Table, cluster_by=None,
+                        max_file_bytes=None):
+        """Stage a table as one or more files CLUSTERED on `cluster_by`:
+        rows are sorted by the key and split into ~`max_file_bytes`
+        slices, so each staged file covers a narrow, mostly-disjoint key
+        range and its zone map actually prunes (an unsorted split gives
+        every file the full key range — zone maps that never exclude
+        anything). Returns the combined staged list for one `_commit`."""
+        if max_file_bytes is None:
+            max_file_bytes = resolve_compact_target_bytes(self.conf)
+        if tbl.num_rows == 0:
+            return []
+        if cluster_by and cluster_by in tbl.schema.names:
+            import pyarrow.compute as pc
+
+            tbl = tbl.take(
+                pc.sort_indices(tbl, sort_keys=[(cluster_by, "ascending")])
+            )
+        n_files = max(1, -(-tbl.nbytes // max(int(max_file_bytes), 1)))
+        per = -(-tbl.num_rows // n_files)
+        staged = []
+        for off in range(0, tbl.num_rows, per):
+            staged.extend(self._stage(tbl.slice(off, per)))
         return staged
 
     def _discard_staged(self, staged):
         """Best-effort cleanup of staged files after an aborted commit (the
         orphan sweep is the backstop for anything missed)."""
-        for rel, _ in staged:
+        for s in staged:
             try:
-                self.fs.rm_file(posixpath.join(self.root, rel))
+                self.fs.rm_file(posixpath.join(self.root, s[0]))
             except OSError:
                 pass
         self._release_writer()  # the aborted transaction's epoch is done
 
     def _commit(self, staged, operation, base_files=None, num_rows=None,
-                schema=None):
+                schema=None, base_stats=None, base_chunks=None,
+                new_chunks=None):
         """Publish the next manifest: base file list + staged files.
 
         Optimistic concurrency with bounded rebase: each attempt reads the
@@ -474,7 +569,19 @@ class LakehouseTable:
         exactly Iceberg's fast-append retry) or ABORTS with
         CommitConflictError (an explicit base file list means the writes
         were derived from a snapshot that is no longer the head; publishing
-        would silently drop the winner's rows)."""
+        would silently drop the winner's rows).
+
+        Zone maps ride along: staged entries carry their file's stats,
+        base files inherit the stats of whichever manifest supplied the
+        base list (the rebased-onto head for appends, `base_stats` for
+        explicit-base transactions), so the `stats` key stays exactly in
+        sync with `files` through every rebase. Same story for the ingest
+        ledger (`ingest_chunks` + `new_chunks`): appends union the head's
+        ledger with this commit's chunk ids — and when every new chunk id
+        is ALREADY in the head's ledger the publish is skipped with
+        _ChunkAlreadyIngested, which is what makes chunk replay after a
+        mid-commit kill exactly-once at the commit point, not merely
+        at the (racy) pre-flight ledger check."""
         from .. import faults
 
         if faults.active():
@@ -499,17 +606,35 @@ class LakehouseTable:
                 base_rows = (
                     cur.get("num_rows", 0) if base_files is None else 0
                 )
+                if base_files is None:
+                    bstats = cur.get("stats") or {}
+                    bchunks = set(cur.get("ingest_chunks") or [])
+                else:
+                    bstats = base_stats or {}
+                    bchunks = set(base_chunks or [])
                 prev_ts = cur["timestamp_ms"]
                 if schema_hex is None:
                     schema_hex = cur.get("schema_hex")
             except LakehouseError:
                 version, base, base_rows, prev_ts = 1, base_files or [], 0, 0
-            files = list(base) + [p for p, _ in staged]
+                bstats = base_stats or {}
+                bchunks = set(base_chunks or [])
+            if new_chunks and set(new_chunks) <= bchunks:
+                # a concurrent (or previous, pre-kill) replay of the same
+                # chunk already published: adding our staged copy would
+                # double the rows
+                raise _ChunkAlreadyIngested(cur["version"])
+            files = list(base) + [s[0] for s in staged]
             total = (
                 num_rows
                 if num_rows is not None
-                else base_rows + sum(n for _, n in staged)
+                else base_rows + sum(s[1] for s in staged)
             )
+            stats = {f: bstats[f] for f in base if f in bstats}
+            for s in staged:
+                if len(s) > 2 and s[2]:
+                    stats[s[0]] = s[2]
+            chunks = sorted(bchunks | set(new_chunks or []))
             manifest = {
                 "version": version,
                 # strictly monotonic so timestamp rollback can never tie
@@ -520,6 +645,10 @@ class LakehouseTable:
                 "num_rows": total,
                 "schema_hex": schema_hex,
             }
+            if stats:
+                manifest["stats"] = stats
+            if chunks:
+                manifest["ingest_chunks"] = chunks
             if _COMMIT_HOOK is not None:
                 _COMMIT_HOOK(self.name, operation, version)
             # optimistic concurrency: publish is create-exclusive, so a
@@ -622,8 +751,30 @@ class LakehouseTable:
         try:
             return self._commit(
                 staged, operation, base_files=[],
-                num_rows=sum(n for _, n in staged),
+                num_rows=sum(s[1] for s in staged),
             )
+        except CommitConflictError:
+            self._discard_staged(staged)
+            raise
+
+    def ingest_chunk(self, tbl, chunk_id: str, cluster_by=None,
+                     max_file_bytes=None):
+        """Exactly-once chunk append for parallel ingest: stage `tbl`
+        clustered on `cluster_by`, then commit with `chunk_id` recorded
+        in the manifest's ingest ledger. The ledger IS the checkpoint —
+        a killed worker's resume replays its chunks, the commit point
+        skips any id already in the head ledger, and staged files from
+        the un-published attempt are below-fence debris for vacuum.
+        Returns the published version, or None when the chunk was
+        already ingested (nothing committed, stage discarded)."""
+        if chunk_id in self.snapshot().ingest_chunks():
+            return None  # cheap pre-flight; the commit point re-checks
+        staged = self.stage_clustered(tbl, cluster_by, max_file_bytes)
+        try:
+            return self._commit(staged, "ingest", new_chunks=[chunk_id])
+        except _ChunkAlreadyIngested:
+            self._discard_staged(staged)
+            return None
         except CommitConflictError:
             self._discard_staged(staged)
             raise
@@ -633,7 +784,8 @@ class LakehouseTable:
         m = self._manifest(version)
         return self._commit(
             [], f"rollback-to-v{version}", base_files=m["files"],
-            num_rows=m.get("num_rows"),
+            num_rows=m.get("num_rows"), base_stats=m.get("stats"),
+            base_chunks=m.get("ingest_chunks"),
         )
 
     def rollback_to_timestamp(self, ts_ms: int) -> int:
@@ -647,6 +799,86 @@ class LakehouseTable:
                 f"{self.path}: no snapshot at or before {ts_ms}"
             )
         return self.rollback_to_version(max(candidates))
+
+    # -- maintenance: compaction (OPTIMIZE) --------------------------------
+    def compact(self, target_bytes=None, min_input_files=None) -> dict:
+        """Small-file rewrite (Iceberg's rewrite_data_files / OPTIMIZE):
+        coalesce files below `target_bytes` into ~target-sized ones so
+        parallel ingest's per-chunk commits don't permanently fragment
+        the layout. Logical content is untouched — num_rows, the ingest
+        ledger, and untouched files' stats carry over; the rewritten
+        files get FRESH zone maps from `_stage` (the merged file's real
+        bounds, not a union of its inputs').
+
+        Runs as an explicit-base transaction: the commit publishes only
+        if the head is still the snapshot the rewrite read, otherwise it
+        aborts with CommitConflictError (a concurrent append's rows must
+        not be dropped) — callers retry the whole pass, as in
+        maintenance._run_dm_statement. Concurrent snapshot-pinned readers
+        are unaffected: the input files stay referenced by retained
+        manifests (and reader leases) until vacuum.
+
+        Returns {"files_in", "files_out", "bytes_in", "version"};
+        version None means nothing worth rewriting."""
+        target_bytes = (
+            resolve_compact_target_bytes(self.conf)
+            if target_bytes is None else int(target_bytes)
+        )
+        if min_input_files is None:
+            min_input_files = resolve_compact_min_files(self.conf)
+        snap = self.snapshot()
+        sizes = {}
+        for rel in snap.rel_files:
+            try:
+                info = self.fs.info(posixpath.join(self.root, rel))
+                sizes[rel] = int(info.get("size") or target_bytes)
+            except OSError:
+                sizes[rel] = target_bytes  # unreadable: never a candidate
+        small = [r for r in snap.rel_files if sizes[r] < target_bytes]
+        if len(small) < max(int(min_input_files), 2):
+            return {"table": self.name, "files_in": 0, "files_out": 0,
+                    "bytes_in": 0, "version": None}
+        # bin-pack in manifest order — ingest commits append key-clustered
+        # files in arrival order, so neighbors usually share a key range
+        # and the merged file keeps a tight zone map
+        groups, cur_group, cur_bytes = [], [], 0
+        for rel in small:
+            cur_group.append(rel)
+            cur_bytes += sizes[rel]
+            if cur_bytes >= target_bytes:
+                groups.append(cur_group)
+                cur_group, cur_bytes = [], 0
+        if len(cur_group) >= 2:
+            groups.append(cur_group)
+        groups = [g for g in groups if len(g) >= 2]
+        if not groups:
+            return {"table": self.name, "files_in": 0, "files_out": 0,
+                    "bytes_in": 0, "version": None}
+        staged, inputs = [], []
+        try:
+            for g in groups:
+                merged = snap.dataset(files=g).to_table()
+                staged.extend(self._stage(merged, schema=merged.schema))
+                inputs.extend(g)
+            replaced = set(inputs)
+            base = [r for r in snap.rel_files if r not in replaced]
+            stats = snap.file_stats()
+            version = self._commit(
+                staged, "optimize", base_files=base,
+                num_rows=snap.manifest.get("num_rows"),
+                base_stats={r: stats[r] for r in base if r in stats},
+                base_chunks=snap.manifest.get("ingest_chunks"),
+            )
+        except Exception:
+            self._discard_staged(staged)
+            raise
+        return {
+            "table": self.name,
+            "files_in": len(inputs),
+            "files_out": len(staged),
+            "bytes_in": sum(sizes[r] for r in inputs),
+            "version": version,
+        }
 
     # -- maintenance: snapshot expiry + vacuum -----------------------------
     def _retain_last(self, retain_last) -> int:

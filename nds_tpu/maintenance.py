@@ -205,6 +205,67 @@ def vacuum_warehouse(warehouse_path, tables=None, retain_last=None,
     return results
 
 
+def optimize_warehouse(warehouse_path, tables=None, target_bytes=None,
+                       min_input_files=None, conf=None):
+    """Compact small files across the warehouse's lakehouse tables
+    (Delta's OPTIMIZE / Iceberg's rewrite_data_files). Chunked parallel
+    ingest and per-statement DM commits both fragment tables into many
+    small files; compaction bin-packs them back toward
+    `engine.lake_compact_target_bytes` under the same OCC commit path as
+    any writer, regenerating each rewritten file's zone map. Snapshot
+    isolation keeps concurrent pinned readers on the pre-compaction
+    manifest, and a racing commit aborts the compaction (retried with the
+    shared conflict backoff), never the other writer. Returns the
+    per-table result dicts."""
+    from .lakehouse.table import (
+        CommitConflictError,
+        LakehouseTable,
+        commit_backoff_base,
+        resolve_conflict_retries,
+    )
+
+    results = []
+    names = tables
+    if names is None:
+        try:
+            names = sorted(os.listdir(warehouse_path))
+        except OSError:
+            names = []
+    for name in names:
+        path = os.path.join(str(warehouse_path), name)
+        if not LakehouseTable.is_table(path):
+            continue
+        lt = LakehouseTable(path, conf=conf)
+        delays = faults.backoff_delays(
+            resolve_conflict_retries(), commit_backoff_base()
+        )
+        while True:
+            try:
+                res = lt.compact(
+                    target_bytes=target_bytes,
+                    min_input_files=min_input_files,
+                )
+                break
+            except CommitConflictError as exc:
+                delay = next(delays, None)
+                if delay is None:
+                    raise
+                print(
+                    f"optimize {name}: commit conflict ({exc}); "
+                    f"re-planning against the new head in {delay:.2f}s"
+                )
+                time.sleep(delay)
+        if res["version"] is not None:
+            print(
+                f"optimize {name}: compacted {res['files_in']} file(s) "
+                f"into {res['files_out']} "
+                f"({res['bytes_in']} bytes rewritten) "
+                f"-> v{res['version']}"
+            )
+        results.append(res)
+    return results
+
+
 def run_maintenance(
     warehouse_path,
     refresh_data_path,
@@ -215,13 +276,18 @@ def run_maintenance(
     use_decimal=True,
     maintenance_sql_dir=None,
     vacuum_after=False,
+    optimize_after=False,
 ):
     """Run the maintenance functions with per-function timing + reports.
 
     Returns the Data Maintenance Time in seconds (Tdm contribution).
-    `vacuum_after` additionally expires old snapshots + sweeps
-    unreferenced data files once the functions complete (retention:
-    `engine.lake_vacuum_retain` / NDS_LAKE_VACUUM_RETAIN, default 2)."""
+    `optimize_after` compacts the small files the per-statement DM
+    commits fragmented (target: `engine.lake_compact_target_bytes` /
+    NDS_LAKE_COMPACT_TARGET_BYTES); `vacuum_after` then expires old
+    snapshots + sweeps unreferenced data files (retention:
+    `engine.lake_vacuum_retain` / NDS_LAKE_VACUUM_RETAIN, default 2).
+    Compaction runs first so its superseded inputs age into the same
+    vacuum horizon as every other dead snapshot."""
     valid_queries = get_valid_query_names(spec_queries)
     app_name = (
         "NDS - Data Maintenance - " + valid_queries[0]
@@ -238,6 +304,7 @@ def run_maintenance(
             session, warehouse_path, refresh_data_path,
             time_log_output_path, json_summary_folder, property_file,
             valid_queries, maintenance_sql_dir, vacuum_after,
+            optimize_after,
         )
     finally:
         # this maintenance run is its tracer's ONLY emitter: closing here
@@ -251,7 +318,7 @@ def run_maintenance(
 def _run_maintenance_body(
     session, warehouse_path, refresh_data_path, time_log_output_path,
     json_summary_folder, property_file, valid_queries, maintenance_sql_dir,
-    vacuum_after,
+    vacuum_after, optimize_after=False,
 ):
     app_id = f"nds-tpu-dm-{os.getpid()}-{int(time.time())}"
 
@@ -291,6 +358,13 @@ def _run_maintenance_body(
                 else:
                     summary_prefix = os.path.join(json_summary_folder, "")
                 q_report.write_summary(query_name, prefix=summary_prefix)
+        if optimize_after:
+            o_start = time.perf_counter()
+            optimize_warehouse(warehouse_path, conf=session.conf)
+            execution_time_list.append(
+                (app_id, "Optimize Time",
+                 round(time.perf_counter() - o_start, 3))
+            )
         if vacuum_after:
             v_start = time.perf_counter()
             vacuum_warehouse(warehouse_path, conf=session.conf)

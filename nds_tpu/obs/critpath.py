@@ -28,6 +28,14 @@ construction):
     ladder-retry    failed attempts' wall (`ladder_rung.attempt_ms`)
     backoff-wait    deliberate sleeps between rungs (delay_s)
     hung-wait       a watchdog-abandoned attempt's budget
+    ingest-decode   measured `ingest_chunk` decode_ms (Arrow decode of
+                    the chunk file in a transcode worker)
+    ingest-commit-wait  measured `ingest_chunk` commit_ms (staging +
+                    the OCC commit, including rebase waits behind
+                    concurrent writers)
+    prune-planning  measured `scan_prune` dur_ms (zone-map evaluation
+                    at plan time — carved out of what used to be the
+                    plan-host residual)
     plan-host       the driver residual: parse/bind/rewrite/budget,
                     host-side result materialization, report overhead —
                     the same "driver time" bucket the reference's
@@ -48,7 +56,8 @@ MAX_RESIDUAL_FRAC = 0.5
 #: cause names in render order
 CAUSE_ORDER = (
     "execute", "exchange-wait", "spill-io", "catalog-load", "ladder-retry",
-    "backoff-wait", "hung-wait", "plan-host",
+    "backoff-wait", "hung-wait", "ingest-decode", "ingest-commit-wait",
+    "prune-planning", "plan-host",
 )
 
 
@@ -59,7 +68,7 @@ def _group_query_events(events) -> dict:
         kind = ev.get("kind")
         if kind in ("op_span", "query_span", "exchange", "spill",
                     "catalog_load", "ladder_rung", "watchdog_fire",
-                    "kernel_span"):
+                    "kernel_span", "ingest_chunk", "scan_prune"):
             q = ev.get("query") or "<unscoped>"
             out.setdefault(q, []).append(ev)
     return out
@@ -142,6 +151,7 @@ def critical_path(events) -> dict:
         spans = []
         exch_ms = skew_ms = spill_ms = cat_ms = 0.0
         ladder_ms = backoff_ms = hung_ms = kernel_ms = 0.0
+        decode_ms = commit_wait_ms = prune_ms = 0.0
         exch_rows = None  # per-device received rows, element-wise summed
         exch_worst = None  # the highest-skew exchange event
         for ev in evs:
@@ -190,6 +200,11 @@ def critical_path(events) -> dict:
                 hung_ms += float(ev.get("budget_s") or 0.0) * 1000.0
             elif kind == "kernel_span":
                 kernel_ms += float(ev.get("dur_ms") or 0.0)
+            elif kind == "ingest_chunk":
+                decode_ms += float(ev.get("decode_ms") or 0.0)
+                commit_wait_ms += float(ev.get("commit_ms") or 0.0)
+            elif kind == "scan_prune":
+                prune_ms += float(ev.get("dur_ms") or 0.0)
         root_incl = sum(
             float(e.get("dur_ms") or 0.0)
             for e in spans
@@ -205,6 +220,7 @@ def critical_path(events) -> dict:
         # budget; counting both would over-attribute)
         others = (
             execute + exch_ms + spill_ms + cat_ms + ladder_ms + backoff_ms
+            + decode_ms + commit_wait_ms + prune_ms
         )
         causes = {
             "execute": round(execute, 3),
@@ -215,6 +231,9 @@ def critical_path(events) -> dict:
             "backoff-wait": round(backoff_ms, 3),
             "hung-wait": round(min(hung_ms, max(wall - others, 0.0)), 3)
             if hung_ms else 0.0,
+            "ingest-decode": round(decode_ms, 3),
+            "ingest-commit-wait": round(commit_wait_ms, 3),
+            "prune-planning": round(prune_ms, 3),
         }
         measured = sum(causes.values())
         residual = wall - measured

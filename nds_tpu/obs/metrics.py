@@ -76,6 +76,13 @@ METRIC_KINDS = {
     "nds_lake_commit_attempts_total": "lake_commit",
     "nds_lake_vacuum_total": "lake_vacuum",
     "nds_lake_vacuum_files_total": "lake_vacuum",
+    "nds_ingest_chunk_total": "ingest_chunk",
+    "nds_ingest_chunk_rows_total": "ingest_chunk",
+    "nds_ingest_chunk_decode_ms_total": "ingest_chunk",
+    "nds_ingest_chunk_commit_ms_total": "ingest_chunk",
+    "nds_scan_prune_total": "scan_prune",
+    "nds_scan_prune_files_total": "scan_prune",
+    "nds_scan_prune_files_pruned_total": "scan_prune",
     "nds_catalog_commit_total": "catalog_commit",
     "nds_catalog_commit_ms_total": "catalog_commit",
     "nds_catalog_lease_total": "catalog_lease",
@@ -523,6 +530,64 @@ class MetricsSink:
             "nds_lake_vacuum_files_total", int(ev.get("files_removed") or 0)
         )
 
+    def _layout_status_locked(self, ev):
+        """The /statusz `layout` section (caller holds _slock): the data-
+        layout subsystem's live tallies — ingest chunk progress on the
+        fill side, zone-map pruning effectiveness on the scan side.
+        Scalars only, so status_snapshot's one-level copy suffices."""
+        lay = self._status.setdefault("layout", {
+            "ingest_chunks": 0, "ingest_rows": 0, "ingest_skipped": 0,
+            "last_ingest_table": None, "prunes": 0, "files_seen": 0,
+            "files_pruned": 0, "last_prune_table": None,
+            "last_ts_ms": None,
+        })
+        lay["last_ts_ms"] = ev.get("ts")
+        return lay
+
+    def _h_ingest_chunk(self, ev):
+        table = str(ev.get("table"))
+        skipped = bool(ev.get("skipped"))
+        self.registry.inc(
+            "nds_ingest_chunk_total", table=table,
+            status="skipped" if skipped else "ok",
+        )
+        self.registry.inc(
+            "nds_ingest_chunk_rows_total", int(ev.get("rows") or 0)
+        )
+        self.registry.inc(
+            "nds_ingest_chunk_decode_ms_total",
+            float(ev.get("decode_ms") or 0.0),
+        )
+        self.registry.inc(
+            "nds_ingest_chunk_commit_ms_total",
+            float(ev.get("commit_ms") or 0.0),
+        )
+        with self._slock:
+            lay = self._layout_status_locked(ev)
+            lay["ingest_chunks"] += 1
+            lay["ingest_rows"] += int(ev.get("rows") or 0)
+            if skipped:
+                lay["ingest_skipped"] += 1
+            lay["last_ingest_table"] = ev.get("table")
+
+    def _h_scan_prune(self, ev):
+        self.registry.inc(
+            "nds_scan_prune_total", table=str(ev.get("table"))
+        )
+        self.registry.inc(
+            "nds_scan_prune_files_total", int(ev.get("files_total") or 0)
+        )
+        self.registry.inc(
+            "nds_scan_prune_files_pruned_total",
+            int(ev.get("files_pruned") or 0),
+        )
+        with self._slock:
+            lay = self._layout_status_locked(ev)
+            lay["prunes"] += 1
+            lay["files_seen"] += int(ev.get("files_total") or 0)
+            lay["files_pruned"] += int(ev.get("files_pruned") or 0)
+            lay["last_prune_table"] = ev.get("table")
+
     def _catalog_status_locked(self, ev):
         """The /statusz `catalog` section (caller holds _slock): scalar
         tallies only, so status_snapshot's one-level dict copy suffices."""
@@ -791,6 +856,8 @@ _HANDLERS = {
     "spill": MetricsSink._h_spill,
     "lake_commit": MetricsSink._h_lake_commit,
     "lake_vacuum": MetricsSink._h_lake_vacuum,
+    "ingest_chunk": MetricsSink._h_ingest_chunk,
+    "scan_prune": MetricsSink._h_scan_prune,
     "catalog_commit": MetricsSink._h_catalog_commit,
     "catalog_lease": MetricsSink._h_catalog_lease,
     "fault_injected": MetricsSink._h_fault_injected,

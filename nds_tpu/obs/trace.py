@@ -153,6 +153,20 @@ EVENT_SCHEMA = {
     # them — the vacuum safety contract made visible
     "lake_vacuum": ("table", "files_removed", "manifests_removed",
                     "files_leased"),
+    # one parallel-ingest chunk committed through the lakehouse ledger
+    # (transcode.py _ingest_chunks → table.ingest_chunk): decode_ms is
+    # the Arrow decode of the chunk file, commit_ms covers stage+commit
+    # (the commit-wait critical-path bucket). Optional: files (staged
+    # file count), version, skipped: true (chunk already in the ledger
+    # — the resume path's exactly-once skip)
+    "ingest_chunk": ("table", "chunk", "rows", "decode_ms", "commit_ms"),
+    # one zone-map pruning pass over a pinned lakehouse scan
+    # (Session._prune_lake_scans): files_pruned of files_total were
+    # excluded by the manifest's per-file stats; rows_bound is the
+    # surviving-row upper bound handed to the budgeter (None when
+    # nothing pruned)
+    "scan_prune": ("table", "files_total", "files_pruned", "rows_bound",
+                   "dur_ms"),
     # one fleet-catalog commit arbitration (lakehouse/catalog.py): outcome
     # is ok | conflict | fenced | unreachable | expired (a slow
     # coordinator refusing a publish past the client's deadline) |

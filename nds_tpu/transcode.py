@@ -13,14 +13,30 @@ report). Differences by design:
     dims land as a single file (the reference's `coalesce(1)`);
   * the load report keeps the reference's exact line format, including the
     TPC-DS 4.3.1 RNGSEED = load-end timestamp the stream generator consumes.
+
+Lakehouse ingest is PARALLEL and RESUMABLE: generator chunk files shard
+round-robin across a multi-process decode pool (`--workers`); each worker
+holds its own epoch-fenced writer lease and commits per chunk through the
+catalog-arbitrated OCC path, recording the chunk id in the manifest's
+ingest ledger. The ledger is the checkpoint — a killed run re-invoked
+with `--resume` replays only unledgered chunks, and the commit point
+itself skips already-ledgered ids (exactly-once even if two resumers
+race). Each worker double-buffers: a decode-ahead thread parses chunk
+i+1 while chunk i stages and commits. Fact chunks are sorted by their
+date surrogate key and split into bounded files, so every committed file
+covers a narrow key range and its zone map (lakehouse/zonemap.py)
+actually prunes.
 """
 
 from __future__ import annotations
 
 import os
+import queue
 import shutil
+import threading
 import time
 from datetime import datetime
+from time import perf_counter as _perf
 from types import SimpleNamespace
 
 import pyarrow as pa
@@ -28,7 +44,6 @@ import pyarrow.dataset as pads
 
 from .io.csv import iter_dat_batches
 from .io.fs import fs_open_atomic
-from .report import engine_conf
 from .schema import TABLE_PARTITIONING, get_maintenance_schemas, get_schemas
 
 
@@ -42,6 +57,8 @@ def transcode_table(
     compression: str | None = None,
     output_mode: str = "errorifexists",
     partition: bool = True,
+    workers: int = 1,
+    resume: bool = False,
 ) -> int:
     """Convert one table; returns rows written."""
     from .io.fs import get_fs, is_remote, join as fs_join
@@ -62,7 +79,12 @@ def transcode_table(
             f"remote output {dst!r} requires --output_format lakehouse"
         )
     dst_fs, dst_path = get_fs(dst)
-    if dst_fs.exists(dst_path):
+    if resume and output_format == "lakehouse":
+        # resuming a killed ingest: the existing table IS the checkpoint
+        # (its manifest ledger names the committed chunks) — the
+        # output_mode exists-handling below must neither raise nor wipe it
+        pass
+    elif dst_fs.exists(dst_path):
         if output_mode in ("errorifexists", "error"):
             raise FileExistsError(f"{dst} exists (use --output_mode overwrite)")
         if output_mode == "ignore":
@@ -89,14 +111,12 @@ def transcode_table(
 
     if output_format == "lakehouse":
         # snapshot-manifest ACID table (Iceberg/Delta analogue) — the
-        # warehouse format the Data Maintenance phase mutates
-        from .lakehouse.table import LakehouseTable
-
-        if LakehouseTable.is_table(dst):
-            LakehouseTable(dst).append(batches())  # output_mode == append
-        else:
-            LakehouseTable.create(dst, batches(), arrow_schema)
-        return rows
+        # warehouse format the Data Maintenance phase mutates. Ingest is
+        # chunk-at-a-time through the manifest ledger (parallel when
+        # workers > 1, resumable always)
+        return _lakehouse_ingest(
+            src, dst, table, schema, arrow_schema, use_decimal, workers
+        )
     if output_format not in ("parquet", "csv", "orc", "json", "avro"):
         raise ValueError(f"unsupported output format {output_format}")
 
@@ -277,6 +297,165 @@ def _write_hive_partitioned_parquet(
     return rows
 
 
+# ---------------------------------------------------------------------------
+# parallel resumable lakehouse ingest
+# ---------------------------------------------------------------------------
+
+
+def _ingest_file_bytes() -> int:
+    """Target bytes per committed data file: clustered chunks split at this
+    size so each file covers a narrow key range its zone map can prune."""
+    return int(os.environ.get("NDS_INGEST_FILE_BYTES", 64 << 20))
+
+
+def _chunk_id(table: str, path: str) -> str:
+    """Ledger id for one generator chunk file. Basename-only so a dataset
+    moved between hosts (different input_prefix) still resumes."""
+    return f"{table}:{os.path.basename(path)}"
+
+
+def _chunk_files(src: str) -> list:
+    """The generator chunk files for a table, in ledger order (same listing
+    io/csv uses, so chunk ids are stable across runs)."""
+    import glob
+
+    if os.path.isfile(src):
+        return [src]
+    return sorted(glob.glob(os.path.join(src, "*.dat")))
+
+
+class _Prefetch:
+    """Depth-1 decode-ahead: a daemon thread parses chunk i+1 while the
+    consumer stages and commits chunk i — the double buffer that overlaps
+    CSV decode with parquet write + OCC commit. Queue depth 1 bounds the
+    buffer to at most two decoded chunks in memory (one queued, one being
+    consumed) plus the one mid-decode."""
+
+    _END = object()
+
+    def __init__(self, paths, schema, use_decimal):
+        self._q = queue.Queue(maxsize=1)
+        self._t = threading.Thread(
+            target=self._run, args=(list(paths), schema, use_decimal),
+            daemon=True,
+        )
+        self._t.start()
+
+    def _run(self, paths, schema, use_decimal):
+        from .io.csv import read_dat_file
+
+        try:
+            for p in paths:
+                t0 = _perf()
+                tbl = read_dat_file(p, schema, use_decimal)
+                self._q.put((p, tbl, (_perf() - t0) * 1000.0))
+        except BaseException as e:  # surfaced to the consumer thread
+            self._q.put(e)
+        else:
+            self._q.put(self._END)
+
+    def __iter__(self):
+        while True:
+            item = self._q.get()
+            if item is self._END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+
+
+def _ingest_chunks(dst, table, schema, use_decimal, chunk_files, part_col):
+    """Ingest a shard of chunk files into the table at `dst`, one ledgered
+    commit per chunk. Runs inside the calling process (each pool worker
+    calls this over its own shard); the LakehouseTable built here carries
+    the process's own epoch-fenced writer lease. Returns
+    (rows_committed, chunks_committed) — skipped (already-ledgered) chunks
+    count toward neither."""
+    from .lakehouse.table import LakehouseTable
+    from .obs import trace as obs_trace
+
+    lt = LakehouseTable(dst)
+    tracer = obs_trace.current()
+    ctx = owned = None
+    if tracer is None:
+        # CLI / pool-worker path: no session bound a tracer in this
+        # thread, so build one from the environment (NDS_TRACE_DIR etc.)
+        # and bind it — fault hooks and ingest events land in the stream
+        # profile --critical-path reads
+        tracer = owned = obs_trace.tracer_from_conf(None)
+        if tracer is not None:
+            ctx = obs_trace.bind(tracer)
+            ctx.__enter__()
+    rows = committed = 0
+    try:
+        for path, tbl, decode_ms in _Prefetch(chunk_files, schema,
+                                              use_decimal):
+            chunk = _chunk_id(table, path)
+            t0 = _perf()
+            version = lt.ingest_chunk(
+                tbl, chunk, cluster_by=part_col,
+                max_file_bytes=_ingest_file_bytes(),
+            )
+            commit_ms = (_perf() - t0) * 1000.0
+            if tracer is not None:
+                tracer.emit(
+                    "ingest_chunk", table=table, chunk=chunk,
+                    rows=tbl.num_rows, decode_ms=round(decode_ms, 3),
+                    commit_ms=round(commit_ms, 3),
+                    skipped=version is None, version=version,
+                )
+            if version is not None:
+                rows += tbl.num_rows
+                committed += 1
+    finally:
+        if ctx is not None:
+            ctx.__exit__(None, None, None)
+        if owned is not None:
+            owned.close()
+    return rows, committed
+
+
+def _ingest_worker(payload):
+    """Top-level (spawn-picklable) pool entry point."""
+    return _ingest_chunks(*payload)
+
+
+def _lakehouse_ingest(src, dst, table, schema, arrow_schema, use_decimal,
+                      workers) -> int:
+    """Parallel resumable ingest of one table. Chunk files shard
+    round-robin over a spawn pool of decode workers; the manifest's ingest
+    ledger is the only checkpoint (see module docstring). Returns rows
+    committed by THIS run — a clean re-run over a complete table returns
+    0, and the table's manifest num_rows is the durable total."""
+    from .lakehouse.table import LakehouseTable
+
+    if not LakehouseTable.is_table(dst):
+        LakehouseTable.create(dst, schema=arrow_schema)
+    done = LakehouseTable(dst).snapshot().ingest_chunks()
+    pending = [p for p in _chunk_files(src)
+               if _chunk_id(table, p) not in done]
+    if not pending:
+        return 0
+    part_col = TABLE_PARTITIONING.get(table)
+    workers = max(1, min(int(workers or 1), len(pending)))
+    if workers == 1:
+        rows, _ = _ingest_chunks(
+            dst, table, schema, use_decimal, pending, part_col
+        )
+        return rows
+    import multiprocessing as mp
+
+    payloads = [
+        (dst, table, schema, use_decimal, pending[i::workers], part_col)
+        for i in range(workers)
+    ]
+    # spawn, not fork: workers re-import cleanly (no inherited JAX/Arrow
+    # thread state) and each registers its own catalog writer lease
+    with mp.get_context("spawn").Pool(processes=workers) as pool:
+        results = pool.map(_ingest_worker, payloads)
+    return sum(r for r, _ in results)
+
+
 def transcode(args) -> dict:
     """Run the full load test; writes the report file; returns timing dict."""
     schemas = (
@@ -307,6 +486,8 @@ def transcode(args) -> dict:
             use_decimal=not args.floats,
             compression=args.compression,
             output_mode=args.output_mode,
+            workers=getattr(args, "workers", 1),
+            resume=getattr(args, "resume", False),
         )
         results[table] = time.perf_counter() - t0
     end_time = datetime.now()
@@ -334,6 +515,10 @@ def transcode(args) -> dict:
             "transcode.update": bool(args.update),
         },
     )
+    # lazy: report pulls in the engine stack, which spawn-mode ingest
+    # workers must not pay to import
+    from .report import engine_conf
+
     # atomic: the transcode report is a phase artifact downstream tooling
     # parses — a crash mid-write must not publish a torn file
     with fs_open_atomic(args.report_file, "w") as report:

@@ -1,0 +1,352 @@
+#!/usr/bin/env python
+"""Parallel-ingest chaos gate (ci/tier1-check).
+
+The PR-16 ingest contract over REAL processes: N writer processes shard
+one table's generator chunks and commit concurrently through the ledgered
+`ingest_chunk` path; one writer is SIGKILLed mid-chunk (staged, hung at
+the commit point); the run must come back exactly-once.
+
+Checks, per catalog backend (legacy off, fs CAS, tcp coordinator):
+
+1. **N-writer convergence** — 3 writers x 3 chunks each over one table:
+   every surviving writer's chunks land exactly once under OCC rebase
+   churn, version history stays linear.
+2. **Kill mid-chunk** — the victim commits its first chunk clean, then a
+   `hang:commit:<table>` fault holds its second chunk between staging
+   and manifest publish; SIGKILL. The chunk must NOT be in the ledger,
+   its staged files are unreferenced debris, no rows appear.
+3. **Vacuum collects the debris** — with the victim dead (and, under a
+   catalog, its writer lease expired + fence advanced), vacuum removes
+   the below-fence stage and touches nothing committed.
+4. **Exactly-once resume** — `_lakehouse_ingest` re-run over the same
+   source replays ONLY the unledgered chunks; the final table holds
+   every generated row exactly once and the ledger is complete.
+
+Usage: python tools/ingest_check.py [--keep]
+"""
+
+import argparse
+import os
+import posixpath
+import re
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import pyarrow as pa  # noqa: E402
+
+from nds_tpu.lakehouse import catalog as C  # noqa: E402
+from nds_tpu.lakehouse.table import LakehouseTable  # noqa: E402
+from nds_tpu.schema import get_schemas  # noqa: E402
+
+WRITERS = 3
+CHUNKS_PER_WRITER = 3
+ROWS_PER_CHUNK = 25
+TABLE = "income_band"
+
+_WRITER_SCRIPT = """
+import os, sys
+sys.path.insert(0, {repo!r})
+from nds_tpu.schema import get_schemas
+from nds_tpu.transcode import _ingest_chunks
+schema = get_schemas(True)[{table!r}]
+shard = sys.argv[1].split(",")
+rows, committed = _ingest_chunks({dst!r}, {table!r}, schema, True, shard, None)
+print("DONE", rows, committed)
+"""
+
+# the victim: first chunk commits clean, then a hang fault pins the second
+# chunk INSIDE the commit critical section (staged, pre-publish) so the
+# parent's SIGKILL is a deterministic death mid-commit
+_VICTIM_SCRIPT = """
+import os, sys
+sys.path.insert(0, {repo!r})
+from nds_tpu import faults
+from nds_tpu.schema import get_schemas
+from nds_tpu.transcode import _ingest_chunks
+schema = get_schemas(True)[{table!r}]
+shard = sys.argv[1].split(",")
+_ingest_chunks({dst!r}, {table!r}, schema, True, shard[:1], None)
+print("CHUNK0-DONE", flush=True)
+faults.install("hang:commit:" + {table!r} + ":600")
+_ingest_chunks({dst!r}, {table!r}, schema, True, shard[1:2], None)
+print("VICTIM-SURVIVED-THE-HANG", flush=True)
+"""
+
+_RESUME_SCRIPT = """
+import os, sys
+sys.path.insert(0, {repo!r})
+import pyarrow as pa
+from nds_tpu.schema import get_schemas
+from nds_tpu.transcode import _lakehouse_ingest
+schema = get_schemas(True)[{table!r}]
+arrow_schema = pa.schema(
+    [(f.name, f.dtype.to_arrow(True)) for f in schema]
+)
+rows = _lakehouse_ingest(
+    {src!r}, {dst!r}, {table!r}, schema, arrow_schema, True, 1
+)
+print("RESUMED", rows)
+"""
+
+
+def _check(ok, label):
+    print(f"  {'OK ' if ok else 'FAIL'} {label}")
+    if not ok:
+        raise SystemExit(f"ingest_check: FAILED: {label}")
+
+
+def _env(**extra):
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "NDS_LAKE_COMMIT_RETRIES": "128",
+        "NDS_LAKE_COMMIT_BACKOFF": "0.005",
+    }
+    env.pop("NDS_FAULT_SPEC", None)
+    env.update(extra)
+    return env
+
+
+def _spawn_coordinator(warehouse):
+    env = _env(NDS_METRICS_HOST="127.0.0.1")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "nds_tpu.cli.catalog", warehouse,
+         "--port", "0"],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+    deadline = time.monotonic() + 60
+    port = None
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        m = re.search(r"coordinating .* on [^:]+:(\d+)", line)
+        if m:
+            port = int(m.group(1))
+            break
+    if port is None:
+        proc.kill()
+        raise SystemExit("ingest_check: coordinator never announced a port")
+    return proc, f"http://127.0.0.1:{port}"
+
+
+def _gen_chunks(src):
+    """Generator chunk files: every row's surrogate key is globally unique,
+    so 'exactly once' is one sorted-list equality at the end."""
+    os.makedirs(src)
+    sk = 0
+    total = WRITERS * CHUNKS_PER_WRITER
+    for c in range(total):
+        with open(os.path.join(src, f"{TABLE}_{c + 1}_{total}.dat"),
+                  "w") as f:
+            for _ in range(ROWS_PER_CHUNK):
+                f.write(f"{sk}|{sk * 10}|{sk * 10 + 9}|\n")
+                sk += 1
+
+
+def _expected_sks():
+    return list(range(WRITERS * CHUNKS_PER_WRITER * ROWS_PER_CHUNK))
+
+
+def _table_sks(dst):
+    return sorted(
+        x["ib_income_band_sk"]
+        for x in LakehouseTable(dst).dataset().to_table().to_pylist()
+    )
+
+
+def _referenced_basenames(dst):
+    lt = LakehouseTable(dst)
+    refs = set()
+    for v, _, _ in lt.versions():
+        for f in lt.snapshot(v).rel_files:
+            refs.add(posixpath.basename(f))
+    return refs
+
+
+def _data_basenames(dst):
+    d = os.path.join(dst, "data")
+    return set(os.listdir(d)) if os.path.isdir(d) else set()
+
+
+def _ledger(dst):
+    return LakehouseTable(dst).snapshot().ingest_chunks()
+
+
+def _chunk_id(path):
+    return f"{TABLE}:{os.path.basename(path)}"
+
+
+def check_mode(workdir, mode, src):
+    print(f"ingest chaos [{mode}]: {WRITERS} writers x "
+          f"{CHUNKS_PER_WRITER} chunks, SIGKILL one mid-chunk")
+    wh = os.path.join(workdir, f"wh-{mode}")
+    os.makedirs(wh)
+    dst = os.path.join(wh, TABLE)
+    schema = get_schemas(True)[TABLE]
+    arrow_schema = pa.schema(
+        [(f.name, f.dtype.to_arrow(True)) for f in schema]
+    )
+    LakehouseTable.create(dst, schema=arrow_schema)
+    chunks = sorted(
+        os.path.join(src, f) for f in os.listdir(src) if f.endswith(".dat")
+    )
+    shards = [chunks[w::WRITERS] for w in range(WRITERS)]
+
+    coord = None
+    try:
+        if mode == "tcp":
+            coord, url = _spawn_coordinator(wh)
+            extra = {"NDS_LAKE_CATALOG": url}
+        elif mode == "fs":
+            extra = {"NDS_LAKE_CATALOG": "fs"}
+        else:
+            extra = {"NDS_LAKE_CATALOG": ""}
+
+        # short writer TTL for the VICTIM only: once killed, its lease
+        # expires fast and the vacuum fence can advance past its epoch
+        # (survivors keep the default TTL — they release on exit anyway)
+        victim = subprocess.Popen(
+            [sys.executable, "-c",
+             _VICTIM_SCRIPT.format(repo=REPO, dst=dst, table=TABLE),
+             ",".join(shards[0])],
+            env=_env(NDS_LAKE_WRITER_TTL_S="0.05", **extra),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        survivors = [
+            subprocess.Popen(
+                [sys.executable, "-c",
+                 _WRITER_SCRIPT.format(repo=REPO, dst=dst, table=TABLE),
+                 ",".join(shards[w])],
+                env=_env(**extra), stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+            )
+            for w in range(1, WRITERS)
+        ]
+        for p in survivors:
+            _out, err = p.communicate(timeout=300)
+            if p.returncode != 0:
+                raise SystemExit(
+                    f"ingest_check: writer failed:\n{err.decode()[-3000:]}"
+                )
+
+        # wait for the victim's clean first commit...
+        line = ""
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            line = victim.stdout.readline()
+            if "CHUNK0-DONE" in line or not line:
+                break
+        _check("CHUNK0-DONE" in line, "victim committed its first chunk")
+        # ...then for its second chunk's stage to appear (the hang holds it
+        # between staging and publish); unreferenced data files are the tell
+        staged = set()
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            staged = _data_basenames(dst) - _referenced_basenames(dst)
+            if staged:
+                break
+            time.sleep(0.05)
+        _check(bool(staged), "victim staged its second chunk (hung pre-publish)")
+        victim.send_signal(signal.SIGKILL)
+        victim.wait(timeout=30)
+    finally:
+        if coord is not None:
+            coord.terminate()
+            coord.wait(timeout=30)
+
+    led = _ledger(dst)
+    _check(_chunk_id(shards[0][0]) in led, "victim's clean chunk is ledgered")
+    _check(_chunk_id(shards[0][1]) not in led,
+           "killed chunk is NOT in the ledger (no torn publish)")
+    committed = {_chunk_id(p) for s in shards for p in s} - {
+        _chunk_id(shards[0][1]), _chunk_id(shards[0][2])
+    }
+    _check(led == committed, "ledger holds exactly the committed chunks")
+
+    # vacuum collects the dead victim's below-fence stage, keeps all data
+    os.environ["NDS_LAKE_WRITER_TTL_S"] = "0.05"
+    if mode == "tcp":
+        # the killed coordinator took its fence state with it; the fs
+        # catalog arbitrates the same warehouse for the cleanup pass
+        os.environ["NDS_LAKE_CATALOG"] = "fs"
+    elif mode == "fs":
+        os.environ["NDS_LAKE_CATALOG"] = "fs"
+    else:
+        os.environ.pop("NDS_LAKE_CATALOG", None)
+    C.reset_clients()
+    try:
+        time.sleep(0.2)  # writer-lease TTL elapses; the zombie is fenceable
+        LakehouseTable(dst).vacuum()
+        remaining = _data_basenames(dst)
+        _check(not (staged & remaining),
+               "vacuum collected the killed writer's stage")
+        _check(_referenced_basenames(dst) <= remaining | staged,
+               "vacuum kept every referenced file")
+
+        # resume: only the unledgered chunks replay; exactly-once overall
+        res = subprocess.run(
+            [sys.executable, "-c",
+             _RESUME_SCRIPT.format(repo=REPO, src=src, dst=dst, table=TABLE)],
+            env=_env(), capture_output=True, text=True, timeout=300,
+        )
+        if res.returncode != 0:
+            raise SystemExit(
+                f"ingest_check: resume failed:\n{res.stderr[-3000:]}"
+            )
+        resumed = int(res.stdout.split("RESUMED", 1)[1].strip())
+        _check(resumed == 2 * ROWS_PER_CHUNK,
+               "resume replayed exactly the two missing chunks")
+    finally:
+        os.environ.pop("NDS_LAKE_WRITER_TTL_S", None)
+        os.environ.pop("NDS_LAKE_CATALOG", None)
+        C.reset_clients()
+
+    _check(_table_sks(dst) == _expected_sks(),
+           "every generated row present exactly once after resume")
+    _check(_ledger(dst) == {_chunk_id(p) for s in shards for p in s},
+           "ledger complete after resume")
+    versions = [v for v, _, _ in LakehouseTable(dst).versions()]
+    _check(versions == sorted(versions), "version history is linear")
+    # a second resume is a no-op (the whole-run idempotence contract)
+    res2 = subprocess.run(
+        [sys.executable, "-c",
+         _RESUME_SCRIPT.format(repo=REPO, src=src, dst=dst, table=TABLE)],
+        env=_env(), capture_output=True, text=True, timeout=300,
+    )
+    _check(res2.returncode == 0 and "RESUMED 0" in res2.stdout,
+           "re-running resume commits nothing (idempotent)")
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the scratch dir for inspection")
+    ap.add_argument("--modes", default="off,fs,tcp",
+                    help="comma-separated catalog backends to exercise")
+    args = ap.parse_args()
+    workdir = tempfile.mkdtemp(prefix="nds-ingest-check-")
+    t0 = time.perf_counter()
+    try:
+        src = os.path.join(workdir, "raw", TABLE)
+        _gen_chunks(src)
+        for mode in args.modes.split(","):
+            check_mode(workdir, mode.strip(), src)
+    finally:
+        if args.keep:
+            print(f"ingest_check: scratch kept at {workdir}")
+        else:
+            shutil.rmtree(workdir, ignore_errors=True)
+    print(f"ingest_check: OK ({time.perf_counter() - t0:.1f}s)")
+
+
+if __name__ == "__main__":
+    main()
