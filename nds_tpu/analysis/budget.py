@@ -427,15 +427,30 @@ class CatalogStats:
                 return int(e.nrows)
             if e.arrow is not None:
                 return int(e.arrow.num_rows)
-            if e.fmt in ("parquet", "orc"):
+            if e.fmt in ("parquet", "orc", "lakehouse"):
                 # memoized metadata count; a FAILED probe is memoized as
                 # -1 but must still fall through to the scale model below
                 # (a transient IO error must not pin the table to
-                # `unknown` for the session's lifetime)
+                # `unknown` for the session's lifetime). Lakehouse tables
+                # answer from the manifest (pinned snapshot when one
+                # exists, else the current head) — a COLD lakehouse
+                # warehouse must still produce enforceable verdicts, or a
+                # serving fleet's admission edge degrades to `unknown`
+                # until every table has been touched once.
                 cached = getattr(e, "budget_est_rows", None)
                 if cached is None:
                     try:
-                        cached = int(self.catalog._dataset(e).count_rows())
+                        if e.fmt == "lakehouse":
+                            snap = e.pinned_snapshot
+                            if snap is None:
+                                from ..lakehouse.table import LakehouseTable
+
+                                snap = LakehouseTable(e.path).snapshot()
+                            cached = int(snap.num_rows())
+                        else:
+                            cached = int(
+                                self.catalog._dataset(e).count_rows()
+                            )
                     except Exception:
                         cached = -1
                     e.budget_est_rows = cached

@@ -107,6 +107,15 @@ def warm_main(args) -> int:
     }
     if failed:
         report["failed"] = failed
+    if getattr(args, "fleet", False):
+        # fleet warm-up contract: ONE warm pass fills the shared dir,
+        # every replica deserializes from it — print the exact flag the
+        # replica launch needs so the deploy recipe is copy-pasteable
+        d = os.environ["NDS_AOT_CACHE_DIR"]
+        report["fleet"] = {
+            "cache_dir": d,
+            "replica_flag": f"--aot_cache_dir {d}",
+        }
     if args.as_json:
         print(json.dumps(report, indent=2))
     else:
@@ -119,6 +128,12 @@ def warm_main(args) -> int:
               f"{report['stats']['bytes']:,} B total")
         for n, e in failed.items():
             print(f"   failed {n}: {e}", file=sys.stderr)
+        if "fleet" in report:
+            print("cache warm --fleet: shared dir ready; start each "
+                  "replica with\n"
+                  f"   nds-tpu-submit serve <warehouse> "
+                  f"{report['fleet']['replica_flag']}\n"
+                  "so N replicas pay one compile, not N")
     if queries and ok == 0:
         # "warm what warms" tolerates stragglers, but a warm run where
         # NOTHING warmed means the fleet will cold-start exactly as if
@@ -173,6 +188,10 @@ def main(argv=None) -> int:
                         help="warehouse format (parquet)")
     p_warm.add_argument("--queries", default=None,
                         help="comma-separated template subset")
+    p_warm.add_argument("--fleet", action="store_true",
+                        help="fleet warm-up: report the --aot_cache_dir "
+                        "flag every serve replica should launch with so "
+                        "N replicas share this one warmed dir")
     _common(p_warm)
     p_vac = sub.add_parser(
         "vacuum",

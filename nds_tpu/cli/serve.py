@@ -54,6 +54,14 @@ def build_service(args):
     conf["engine.metrics_port"] = port
     if args.job_dir:
         conf["engine.serve_job_dir"] = args.job_dir
+    # fleet AOT warm-up: every replica pointed at ONE shared cache dir
+    # deserializes the executables `cache warm --fleet` compiled once,
+    # instead of paying a per-host compile (the cache is multi-process
+    # safe). getattr: older Namespace callers (tools, tests) predate it.
+    aot_dir = getattr(args, "aot_cache_dir", None)
+    if aot_dir:
+        conf["engine.aot_cache_dir"] = aot_dir
+        os.environ["NDS_AOT_CACHE_DIR"] = aot_dir
     use_decimal = not args.floats
     session = Session(use_decimal=use_decimal, conf=conf)
     # DML runs on its own session (own caches, own last_plan_budget) so
@@ -130,6 +138,12 @@ def main(argv=None):
     parser.add_argument(
         "--floats", action="store_true",
         help="use double instead of decimal for decimal-typed columns",
+    )
+    parser.add_argument(
+        "--aot_cache_dir",
+        help="shared AOT executable cache dir (engine.aot_cache_dir): "
+        "point every fleet replica at the dir `cache warm --fleet` "
+        "filled so N replicas pay one compile, not N",
     )
     args = parser.parse_args(argv)
     service, server = build_service(args)

@@ -46,6 +46,23 @@ Injection sites fired around the codebase:
                           never the server)
     serve:exec            serve-mode request execution (walks the same
                           BenchReport ladder a bench query would)
+    replica:kill          serve-mode SELECT execution, fleet family
+                          (hang/crash kinds only): hang holds the request
+                          open for a deterministic external SIGKILL window
+                          (tools/fleet_check.py); crash kills the
+                          connection thread mid-request so the socket
+                          closes with no reply — what a mid-stream replica
+                          death looks like to the router
+    route:pick            router replica selection (serve/router.py): an
+                          injected failure sheds the request at the edge,
+                          never the router process (io/hang/crash kinds)
+    route:forward         router -> replica forward hop: an injected io
+                          failure looks like a dead replica and exercises
+                          the failover retry budget (io/hang/crash kinds)
+    catalog:unreachable   tcp catalog client transport (HttpCatalog._post
+                          entry): the call fails CatalogUnreachableError
+                          without touching the wire — coordinator-loss
+                          drills without killing a process (io/hang kinds)
     any path substring    fs_open (fired via maybe_fire_path)
 
 The registry is a module singleton; when no spec is installed every

@@ -806,6 +806,17 @@ class HttpCatalog:
               timeout_s: float | None = None) -> dict:
         import http.client
 
+        if faults.active():
+            # fleet chaos site: an injected io fault here makes the
+            # coordinator unreachable WITHOUT killing its process — the
+            # same CatalogUnreachableError surface a SIGKILL'd
+            # coordinator produces (degraded-mode drills in-process)
+            try:
+                faults.maybe_fire("catalog:unreachable", kinds=("io", "hang"))
+            except faults.FaultError as exc:
+                raise CatalogUnreachableError(
+                    f"catalog unreachable at {self.url} (injected: {exc})"
+                ) from exc
         body = json.dumps(payload).encode("utf-8")
         conn = http.client.HTTPConnection(
             self.host, self.port,

@@ -36,6 +36,16 @@ construction):
     prune-planning  measured `scan_prune` dur_ms (zone-map evaluation
                     at plan time — carved out of what used to be the
                     plan-host residual)
+    router-queue    `route_request` queue_ms: router-edge admission
+                    (verdict cache lookup / /plan probe + replica pick)
+                    before the first forward left the router
+    router-forward  router-side upstream wire time NOT explained by
+                    replica-side execution, max(forward_ms - replica
+                    wall, 0) — failover retries, backoff sleeps, and
+                    transfer. When a trace has route events but no
+                    replica query_span (the replica died, or only the
+                    router's log is at hand), the whole forward time
+                    lands here and the route dur_ms IS the wall
     plan-host       the driver residual: parse/bind/rewrite/budget,
                     host-side result materialization, report overhead —
                     the same "driver time" bucket the reference's
@@ -57,7 +67,7 @@ MAX_RESIDUAL_FRAC = 0.5
 CAUSE_ORDER = (
     "execute", "exchange-wait", "spill-io", "catalog-load", "ladder-retry",
     "backoff-wait", "hung-wait", "ingest-decode", "ingest-commit-wait",
-    "prune-planning", "plan-host",
+    "prune-planning", "router-queue", "router-forward", "plan-host",
 )
 
 
@@ -68,7 +78,8 @@ def _group_query_events(events) -> dict:
         kind = ev.get("kind")
         if kind in ("op_span", "query_span", "exchange", "spill",
                     "catalog_load", "ladder_rung", "watchdog_fire",
-                    "kernel_span", "ingest_chunk", "scan_prune"):
+                    "kernel_span", "ingest_chunk", "scan_prune",
+                    "route_request"):
             q = ev.get("query") or "<unscoped>"
             out.setdefault(q, []).append(ev)
     return out
@@ -152,6 +163,9 @@ def critical_path(events) -> dict:
         exch_ms = skew_ms = spill_ms = cat_ms = 0.0
         ladder_ms = backoff_ms = hung_ms = kernel_ms = 0.0
         decode_ms = commit_wait_ms = prune_ms = 0.0
+        route_n = 0
+        route_dur_ms = route_queue_ms = route_forward_ms = 0.0
+        route_status = None
         exch_rows = None  # per-device received rows, element-wise summed
         exch_worst = None  # the highest-skew exchange event
         for ev in evs:
@@ -205,6 +219,28 @@ def critical_path(events) -> dict:
                 commit_wait_ms += float(ev.get("commit_ms") or 0.0)
             elif kind == "scan_prune":
                 prune_ms += float(ev.get("dur_ms") or 0.0)
+            elif kind == "route_request":
+                route_n += 1
+                route_dur_ms += float(ev.get("dur_ms") or 0.0)
+                route_queue_ms += float(ev.get("queue_ms") or 0.0)
+                route_forward_ms += float(ev.get("forward_ms") or 0.0)
+                if route_status != "Failed":
+                    route_status = (
+                        "Completed" if ev.get("status") == "completed"
+                        else "Failed"
+                    )
+        if route_n:
+            # the router hop wraps replica-side execution: the router's
+            # end-to-end dur is the fleet wall (>= the replica's
+            # query_span wall when both logs fold into one trace), and
+            # router-forward is only the upstream time the replica wall
+            # does NOT explain (failover retries, backoff, transfer) so
+            # the buckets stay disjoint
+            replica_wall = wall
+            wall = max(wall, route_dur_ms)
+            runs = runs or route_n
+            status = status or route_status
+            route_forward_ms = max(route_forward_ms - replica_wall, 0.0)
         root_incl = sum(
             float(e.get("dur_ms") or 0.0)
             for e in spans
@@ -221,6 +257,7 @@ def critical_path(events) -> dict:
         others = (
             execute + exch_ms + spill_ms + cat_ms + ladder_ms + backoff_ms
             + decode_ms + commit_wait_ms + prune_ms
+            + route_queue_ms + route_forward_ms
         )
         causes = {
             "execute": round(execute, 3),
@@ -234,6 +271,8 @@ def critical_path(events) -> dict:
             "ingest-decode": round(decode_ms, 3),
             "ingest-commit-wait": round(commit_wait_ms, 3),
             "prune-planning": round(prune_ms, 3),
+            "router-queue": round(route_queue_ms, 3),
+            "router-forward": round(route_forward_ms, 3),
         }
         measured = sum(causes.values())
         residual = wall - measured

@@ -103,6 +103,10 @@ METRIC_KINDS = {
     "nds_serve_request_dur_ms": "serve_request",    # histogram (p99 scrape)
     "nds_serve_request_rows_total": "serve_request",
     "nds_serve_request_bytes_total": "serve_request",
+    "nds_route_request_total": "route_request",
+    "nds_route_request_ms_total": "route_request",
+    "nds_route_request_dur_ms": "route_request",    # histogram (fleet p99)
+    "nds_route_retry_total": "route_retry",
 }
 
 #: bounded histogram buckets (ms): an hour-long query lands in +Inf, the
@@ -342,6 +346,16 @@ class MetricsSink:
         # their in-flight records apart. Non-serve callers pass None and
         # keep the (app, query) semantics unchanged.
         self._in_flight = {}
+        # router-process hook (serve/router.py): a callable returning the
+        # live fleet view (replica health, degraded capabilities, tenant
+        # in-flight) merged into /statusz's "fleet" section at snapshot
+        # time — the router owns that state, the sink only tallies events
+        self._fleet_provider = None
+
+    def set_fleet_provider(self, fn):
+        """Install the router's fleet-snapshot callable (or None to
+        clear). Called OUTSIDE the status lock at snapshot time."""
+        self._fleet_provider = fn
 
     # -- direct harness hooks -------------------------------------------
     def query_started(self, name, app=None, request_id=None):
@@ -746,6 +760,62 @@ class MetricsSink:
                       "plan_cache_hits", "plan_cache_lookups"):
                 t[k] += int(ev.get(k) or 0)
 
+    def _h_route_request(self, ev):
+        """Router-edge accounting (serve/router.py): the same tenant
+        folding bound as serve_request — the fleet tenants section is
+        the per-tenant FLEET counter home (satellite: the per-replica
+        serve_tenant_cap's router-enforced equivalent reports here)."""
+        tenant = str(ev.get("tenant"))
+        with self._slock:
+            fleet = self._status.get("fleet") or {}
+            known = fleet.get("tenants") or {}
+            if (
+                tenant not in known
+                and len(known) >= self.MAX_TENANT_SERIES
+            ):
+                tenant = "__other__"
+        status = str(ev.get("status"))
+        dur = float(ev.get("dur_ms") or 0.0)
+        self.registry.inc(
+            "nds_route_request_total", tenant=tenant, status=status
+        )
+        self.registry.inc("nds_route_request_ms_total", dur, tenant=tenant)
+        # unlabeled on purpose, like nds_serve_request_dur_ms: the fleet
+        # bench p99 scrape inverts ONE bucket series
+        self.registry.observe("nds_route_request_dur_ms", dur)
+        with self._slock:
+            fleet = self._status.setdefault("fleet", {
+                "requests": 0, "edge_rejected": 0, "retries": 0,
+                "tenants": {},
+            })
+            fleet["requests"] += 1
+            if status == "rejected" or (
+                status == "shed" and ev.get("replica") is None
+            ):
+                # answered at the edge: no replica worker slot consumed
+                fleet["edge_rejected"] += 1
+            tenants = fleet.setdefault("tenants", {})
+            t = tenants.setdefault(tenant, {
+                "requests": 0, "completed": 0, "failed": 0, "rejected": 0,
+                "shed": 0, "draining": 0, "retries": 0, "ms_total": 0.0,
+            })
+            t["requests"] += 1
+            if status in t:
+                t[status] += 1
+            t["retries"] += int(ev.get("retries") or 0)
+            t["ms_total"] = round(t["ms_total"] + dur, 3)
+
+    def _h_route_retry(self, ev):
+        self.registry.inc(
+            "nds_route_retry_total", reason=str(ev.get("reason"))
+        )
+        with self._slock:
+            fleet = self._status.setdefault("fleet", {
+                "requests": 0, "edge_rejected": 0, "retries": 0,
+                "tenants": {},
+            })
+            fleet["retries"] += 1
+
     def _h_heartbeat(self, ev):
         self.registry.inc("nds_heartbeat_total")
         if ev.get("rss_bytes") is not None:
@@ -810,6 +880,16 @@ class MetricsSink:
                         )
                     tenants[name] = t
                 st["tenants"] = tenants
+            if "fleet" in st:
+                # deep-copy: the tallies keep mutating under this lock
+                fleet = self._status["fleet"]
+                st["fleet"] = {
+                    k: (
+                        {tn: dict(t) for tn, t in v.items()}
+                        if k == "tenants" else v
+                    )
+                    for k, v in fleet.items()
+                }
             in_flight = []
             for rec in self._in_flight.values():
                 rec = dict(rec)
@@ -836,6 +916,21 @@ class MetricsSink:
         st["heartbeat_age_ms"] = (now_ms - hb) if hb else None
         # nds-lint: disable=perf-counter
         st["uptime_ms"] = now_ms - st["started_ts_ms"]
+        provider = self._fleet_provider
+        if provider is not None:
+            # live router state (replica health, degraded capabilities,
+            # fleet tenant in-flight) — merged outside _slock: the
+            # provider takes the router's own lock
+            try:
+                live = provider()
+            except Exception:
+                live = None
+            if isinstance(live, dict):
+                fleet = st.setdefault("fleet", {
+                    "requests": 0, "edge_rejected": 0, "retries": 0,
+                    "tenants": {},
+                })
+                fleet.update(live)
         return st
 
 
@@ -871,6 +966,8 @@ _HANDLERS = {
     "mem_watermark": MetricsSink._h_mem_watermark,
     "heartbeat": MetricsSink._h_heartbeat,
     "serve_request": MetricsSink._h_serve_request,
+    "route_request": MetricsSink._h_route_request,
+    "route_retry": MetricsSink._h_route_retry,
 }
 
 # every handled kind must be a real schema kind (drift breaks import, not

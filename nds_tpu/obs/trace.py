@@ -185,6 +185,20 @@ EVENT_SCHEMA = {
     # (exec_cache_hits/_lookups, plan_cache_hits/_lookups) that feed the
     # per-tenant hit rates on /statusz.
     "serve_request": ("tenant", "status", "dur_ms", "http_status"),
+    # one router-edge request outcome (nds_tpu/serve/router.py): status is
+    # completed | failed | rejected | shed | draining, http_status the
+    # answer the CLIENT saw. Optional: request_id, replica (the upstream
+    # that served it), verdict (cached/probed budget verdict that drove
+    # the pick), stmt_class (select | dml), attempts (total upstream
+    # forwards), retries, queue_ms (edge admission: verdict lookup +
+    # replica pick), forward_ms (total upstream wire time), query — the
+    # critical-path profiler folds queue_ms/forward_ms into the
+    # router-queue / router-forward buckets.
+    "route_request": ("tenant", "status", "dur_ms", "http_status"),
+    # one router failover/shed retry decision (nds_tpu/serve/router.py):
+    # reason is connect | midstream | shed | fault | upstream. Optional:
+    # tenant, request_id, attempt, delay_ms
+    "route_retry": ("replica", "reason"),
     # liveness beacon from the per-query memory-sampler thread
     # (obs/memwatch.py, armed by report.py while a traced query runs):
     # a hung query keeps heartbeating, so the hang is visible live on

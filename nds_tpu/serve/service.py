@@ -249,6 +249,12 @@ class QueryService:
         # abandoning the leases to TTL expiry (the PR-12 leak bound)
         self._deferred_leases = []
         self._tenant_in_flight = {}
+        # DML idempotency ledger (router retries): request_key -> the
+        # recorded completed envelope, or None while the original
+        # delivery is still running. Bounded FIFO — the keys are
+        # router-minted uuids, one per client DML request.
+        self._dml_keys = {}
+        self._dml_key_order = []
         self.draining = False
         self.started_ts_ms = int(time.time() * 1000)
         from .jobs import StreamJobs
@@ -263,7 +269,13 @@ class QueryService:
         or None for paths this app doesn't own (the caller 404s)."""
         tenant = str(headers.get("x-nds-tenant") or "default")
         if method == "POST" and path == "/query":
-            return self.handle_query(self._json_body(body), tenant)
+            return self.handle_query(
+                self._json_body(body), tenant,
+                rid=self._adopt_rid(headers),
+                request_key=self._request_key(headers),
+            )
+        if method == "POST" and path == "/plan":
+            return self.handle_plan(self._json_body(body), tenant)
         if method == "POST" and path == "/stream":
             return self.handle_stream(self._json_body(body), tenant)
         if method == "GET" and path.startswith("/jobs/"):
@@ -272,6 +284,35 @@ class QueryService:
             return self.handle_drain()
         if method == "POST" and path == "/reload":
             return self.handle_reload()
+        return None
+
+    @staticmethod
+    def _adopt_rid(headers):
+        """Router-stamped trace context: `x-nds-trace-context` is the
+        HTTP carriage of NDS_TRACE_CONTEXT ("trace_id,parent"); the
+        trace_id half becomes this request's rid, so ONE trace_id greps
+        router -> replica -> catalog -> engine, and a failover retry of
+        the same client request lands in BOTH replicas' event logs under
+        the same id. Malformed/oversized values fall back to a local rid
+        (the header is client-controllable in principle)."""
+        raw = headers.get("x-nds-trace-context") or ""
+        rid = str(raw).split(",", 1)[0].strip()
+        if rid and len(rid) <= 64 and all(
+            c.isalnum() or c in "-_." for c in rid
+        ):
+            return rid
+        return None
+
+    @staticmethod
+    def _request_key(headers):
+        """Router-minted DML idempotency key (`x-nds-request-key`): a
+        re-delivered DML with a known key answers the recorded envelope
+        instead of re-running the statement."""
+        key = str(headers.get("x-nds-request-key") or "").strip()
+        if key and len(key) <= 64 and all(
+            c.isalnum() or c in "-_." for c in key
+        ):
+            return key
         return None
 
     @staticmethod
@@ -441,8 +482,8 @@ class QueryService:
             text = text.replace("${" + str(k) + "}", str(v))
         return text, str(name)
 
-    def handle_query(self, payload, tenant):
-        rid = uuid.uuid4().hex[:12]
+    def handle_query(self, payload, tenant, rid=None, request_key=None):
+        rid = rid or uuid.uuid4().hex[:12]
         t0 = time.perf_counter()
         if self.draining:
             return self._shed_reply(
@@ -486,7 +527,8 @@ class QueryService:
             )
         try:
             return self._admitted_query(
-                payload, tenant, rid, t0, sql_text, qlabel
+                payload, tenant, rid, t0, sql_text, qlabel,
+                request_key=request_key,
             )
         finally:
             self._leave(tenant, rid)
@@ -512,14 +554,17 @@ class QueryService:
             )
         return "dml", stmts
 
-    def _admitted_query(self, payload, tenant, rid, t0, sql_text, qlabel):
+    def _admitted_query(self, payload, tenant, rid, t0, sql_text, qlabel,
+                        request_key=None):
         try:
             kind, stmts = self._classify_statements(sql_text)
         except Exception as exc:
             self._emit_request(rid, tenant, "failed", t0, 400, query=qlabel)
             return self._reply(400, {"request_id": rid, "error": str(exc)})
         if kind == "dml":
-            return self._run_dml(sql_text, tenant, rid, t0, qlabel)
+            return self._run_dml(
+                sql_text, tenant, rid, t0, qlabel, request_key=request_key
+            )
         # plan + capture THIS statement's budgeter verdict atomically
         # (Session.plan_stmt holds the cache lock): admission control.
         # The classification pass above already parsed — plan the AST.
@@ -587,6 +632,56 @@ class QueryService:
         )
         return (200, "application/json", body, ())
 
+    def handle_plan(self, payload, tenant):
+        """Verdict probe for the fleet router (POST /plan): resolve +
+        classify + plan one statement and answer the budget verdict
+        WITHOUT consuming an admission slot and WITHOUT emitting a
+        serve_request event — an edge-rejected 429 must provably never
+        cost a replica worker slot, and the probe must not show up in
+        per-tenant serve accounting (the router's own route_request
+        event is the probe's telemetry). Planning still serializes on
+        the session cache lock, which is exactly the cost the router's
+        verdict cache amortizes."""
+        rid = uuid.uuid4().hex[:12]
+        try:
+            sql_text, _ = self.resolve_sql(payload)
+        except KeyError as exc:
+            return self._reply(404, {"request_id": rid, "error": str(exc)})
+        except ValueError as exc:
+            return self._reply(400, {"request_id": rid, "error": str(exc)})
+        try:
+            kind, stmts = self._classify_statements(sql_text)
+        except Exception as exc:
+            return self._reply(400, {"request_id": rid, "error": str(exc)})
+        if kind == "dml":
+            # DML never has a budget verdict; the router routes it by
+            # class (writer path), not by verdict
+            return self._reply(200, {
+                "request_id": rid, "kind": "dml", "verdict": None,
+            })
+        try:
+            _res, budget = self.session.plan_stmt(stmts[0])
+        except PlanBudgetError as exc:
+            # a probe answering "reject" is a 200: the PROBE succeeded;
+            # the router turns the verdict into the client's 429
+            return self._reply(200, {
+                "request_id": rid, "kind": "select", "verdict": "reject",
+                "error": str(exc),
+                "peak_bytes": int(exc.peak_bytes),
+                "budget_bytes": int(exc.budget_bytes),
+            })
+        except Exception as exc:
+            return self._reply(400, {
+                "request_id": rid, "error": f"{type(exc).__name__}: {exc}",
+            })
+        budget = budget or {}
+        return self._reply(200, {
+            "request_id": rid, "kind": "select",
+            "verdict": budget.get("verdict"),
+            "peak_bytes": budget.get("peak_bytes"),
+            "budget_bytes": budget.get("budget_bytes"),
+        })
+
     def _execute_select(self, res, qname, rid, tenant, budget):
         """Run one planned SELECT under the BenchReport failure ladder
         with a request-scoped tracer (on the admitted connection thread —
@@ -604,6 +699,15 @@ class QueryService:
                 # injected OOM recovers + retries) and the pool-health
                 # contract (a crash kills one request, not the pool)
                 faults.maybe_fire("serve:exec")
+                # fleet chaos site: `hang` holds this request open for a
+                # deterministic external SIGKILL window (the fleet_check
+                # failover drill); `crash` kills the connection thread
+                # mid-request so the socket closes with NO reply — what a
+                # mid-stream replica death looks like to the router. Fired
+                # under the bound request tracer, so the fault_injected
+                # event lands in this replica's log with the request's
+                # trace_id (the failover trace evidence).
+                faults.maybe_fire("replica:kill", kinds=("hang", "crash"))
                 box["arrow"] = res.collect(tracer=rt)
 
         with obs_trace.bind(rt):
@@ -642,7 +746,45 @@ class QueryService:
     # ------------------------------------------------------------------
     # DML (writer path)
     # ------------------------------------------------------------------
-    def _run_dml(self, sql_text, tenant, rid, t0, qlabel):
+    #: DML idempotency keys remembered before FIFO eviction — deep enough
+    #: that a router retry (seconds later) always finds its key, bounded
+    #: so a long-lived replica never grows without limit
+    DML_KEY_CAP = 1024
+
+    def _dml_key_begin(self, key):
+        """Claim a DML idempotency key. Returns "run" (first delivery —
+        go), "inflight" (the original delivery is still executing: the
+        duplicate is shed retryable instead of double-applying), or the
+        recorded envelope dict (already committed: answer it verbatim,
+        marked deduped)."""
+        with self._state_lock:
+            if key in self._dml_keys:
+                hit = self._dml_keys[key]
+                return "inflight" if hit is None else hit
+            self._dml_keys[key] = None
+            self._dml_key_order.append(key)
+            while len(self._dml_key_order) > self.DML_KEY_CAP:
+                self._dml_keys.pop(self._dml_key_order.pop(0), None)
+        return "run"
+
+    def _dml_key_end(self, key, envelope):
+        """Record the completed envelope under the key — or, on failure
+        (envelope None), release the claim so the router's classified
+        retry can re-run the statement (an aborted OCC commit published
+        nothing)."""
+        with self._state_lock:
+            if envelope is None:
+                if self._dml_keys.get(key, "x") is None:
+                    del self._dml_keys[key]
+                    try:
+                        self._dml_key_order.remove(key)
+                    except ValueError:
+                        pass
+            else:
+                self._dml_keys[key] = dict(envelope)
+
+    def _run_dml(self, sql_text, tenant, rid, t0, qlabel,
+                 request_key=None):
         """DML on the writer session, serialized in-process: statement-
         level commit-conflict re-runs ride maintenance's one retry home
         (an aborted OCC commit published nothing, so the re-run derives
@@ -660,6 +802,24 @@ class QueryService:
         avoidance, not the correctness mechanism)."""
         from ..maintenance import _run_dm_statement
 
+        if request_key:
+            # idempotency guard (router-minted x-nds-request-key): a
+            # re-delivered committed DML answers the recorded envelope;
+            # a concurrent duplicate sheds instead of double-applying
+            claim = self._dml_key_begin(request_key)
+            if claim == "inflight":
+                return self._shed_reply(
+                    rid, tenant, t0,
+                    f"request key {request_key!r} is already in flight; "
+                    "retry",
+                )
+            if isinstance(claim, dict):
+                envelope = dict(claim)
+                envelope.update({"request_id": rid, "deduped": True})
+                self._emit_request(
+                    rid, tenant, "completed", t0, 200, query=qlabel
+                )
+                return self._reply(200, envelope)
         session = self.writer_session or self.session
         qname = qlabel or f"serve-dm-{rid}"
         rt = _RequestTracer(getattr(session, "tracer", None), rid, tenant)
@@ -671,12 +831,21 @@ class QueryService:
                 faults.maybe_fire("serve:exec")
                 box["result"] = _run_dm_statement(session, sql_text)
 
-        with obs_trace.bind(rt), self._writer_lock:
-            summary = report.report_on(
-                run, retry_oom=False, name=qname, request_id=rid,
-            )
+        try:
+            with obs_trace.bind(rt), self._writer_lock:
+                summary = report.report_on(
+                    run, retry_oom=False, name=qname, request_id=rid,
+                )
+        except BaseException:
+            # includes InjectedCrash: the claim must not orphan — the
+            # router's classified retry needs to be able to re-run
+            if request_key:
+                self._dml_key_end(request_key, None)
+            raise
         status = summary["queryStatus"][-1]
         if status == "Failed":
+            if request_key:
+                self._dml_key_end(request_key, None)
             self._emit_request(
                 rid, tenant, "failed", t0, 500, query=qlabel,
                 tallies=dict(rt.tallies),
@@ -695,6 +864,8 @@ class QueryService:
             "version": getattr(result, "version", None),
             "elapsed_ms": round((time.perf_counter() - t0) * 1000.0, 3),
         }
+        if request_key:
+            self._dml_key_end(request_key, envelope)
         self._emit_request(
             rid, tenant, "completed", t0, 200, query=qlabel, rows=rows,
             tallies=dict(rt.tallies),
@@ -789,6 +960,13 @@ class QueryService:
             reloaded["leases_dropped"] = len(dropped)
             reloaded["leases_deferred"] = 0 if release_now else len(dropped)
         reloaded["sessions"] = len(sessions)
+        # a reloaded replica re-enters service: the rolling fleet recipe
+        # is drain -> reload -> resume, and /reload is the resume (the
+        # router stops routing the moment /healthz flips 503 on drain,
+        # and starts again when the reload answer arrives)
+        with self._state_lock:
+            reloaded["undrained"] = self.draining
+            self.draining = False
         return self._reply(200, reloaded)
 
     def close(self):

@@ -1,0 +1,646 @@
+"""Fleet-router tests: verdict routing, edge rejects that provably
+consume no replica worker slot, failover on replica death, the
+anti-storm retry token bucket, fleet tenant caps, DML idempotency keys,
+the degraded-DML circuit on coordinator loss, rolling reload, and the
+router hop in the request trace.
+
+Replicas are REAL QueryServices behind real ephemeral listeners (each on
+its own obs/httpserv.MetricsServer over the process-shared sink) and the
+router fronts them over actual HTTP — the wire contract is what is
+asserted. Multi-process chaos (SIGKILL mid-query, coordinator loss with
+a live tcp catalog) lives in tools/fleet_check.py."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from nds_tpu import faults
+from nds_tpu.engine.session import Session
+from nds_tpu.lakehouse.table import LakehouseTable
+from nds_tpu.obs import httpserv as HS
+from nds_tpu.obs import metrics as M
+from nds_tpu.obs import trace as obs_trace
+from nds_tpu.serve.router import QueryRouter, Replica
+from nds_tpu.serve.service import QueryService
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.reset()
+    M.reset_shared()
+    yield
+    faults.reset()
+    M.reset_shared()
+
+
+def _fact_table(rows=64):
+    return pa.table({
+        "k": pa.array(np.arange(rows) % 8, type=pa.int64()),
+        "v": pa.array(np.arange(rows), type=pa.int64()),
+    })
+
+
+def _mini_lake(tmp_path, rows=64):
+    path = str(tmp_path / "fact")
+    LakehouseTable.create(path, _fact_table(rows))
+    return path
+
+
+QUERY = "select k, count(*) c, sum(v) s from fact group by k order by k"
+POINT = "select k, v from fact where v = 3 limit 1"
+
+#: fast, prober-less router defaults for in-process tests
+RCONF = {
+    "engine.route_health_interval_s": 0,
+    "engine.route_backoff_base_s": 0.005,
+    "engine.route_backoff_cap_s": 0.02,
+}
+
+
+@pytest.fixture
+def fleet():
+    """Builder for in-process fleets; tears everything down in order
+    (routers first so the prober stops, then services, then listeners)."""
+    made = {"servers": [], "services": [], "routers": []}
+
+    class F:
+        @staticmethod
+        def replica(conf=None, lake_path=None, templates=None, rows=64):
+            conf = {"engine.metrics_port": 0, **(conf or {})}
+            session = Session(conf=conf)
+            if lake_path is not None:
+                session.register_lakehouse("fact", lake_path)
+            else:
+                session.register_arrow("fact", _fact_table(rows))
+            service = QueryService(session, templates=templates)
+            # each replica needs its OWN listener (the process singleton
+            # hosts at most one app); all share the process-wide sink
+            srv = HS.MetricsServer(
+                M.shared_sink(), 0, host="127.0.0.1"
+            ).start()
+            srv.attach_app(service)
+            made["servers"].append(srv)
+            made["services"].append(service)
+            return service, srv.port, srv
+
+        @staticmethod
+        def router(ports, conf=None, mesh_port=None, trace_dir=None):
+            rconf = {**RCONF, "engine.metrics_port": 0, **(conf or {})}
+            if trace_dir:
+                rconf["engine.trace_dir"] = str(trace_dir)
+            tracer = obs_trace.tracer_from_conf(rconf, app_id="nds-route")
+            router = QueryRouter(
+                [f"127.0.0.1:{p}" for p in ports], conf=rconf,
+                tracer=tracer,
+                mesh_replica=(
+                    f"127.0.0.1:{mesh_port}" if mesh_port else None
+                ),
+            )
+            srv = HS.MetricsServer(
+                M.shared_sink(), 0, host="127.0.0.1"
+            ).start()
+            srv.attach_app(router)
+            made["servers"].append(srv)
+            made["routers"].append(router)
+            return router, srv.port
+
+    yield F
+    for r in made["routers"]:
+        r.close()
+    for s in made["services"]:
+        try:
+            s.close()
+        except Exception:
+            pass
+    for srv in made["servers"]:
+        try:
+            srv.stop()
+        except Exception:
+            pass
+
+
+def _post(port, payload, tenant="default", path="/query", timeout=120,
+          headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json",
+                 "X-NDS-Tenant": tenant, **(headers or {})},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        try:
+            body = json.loads(e.read().decode())
+        except ValueError:
+            body = {}
+        return e.code, body, dict(e.headers)
+
+
+def _get(port, path, timeout=30):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout
+        ) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# address parsing, classification, fingerprints (pure units)
+# ---------------------------------------------------------------------------
+
+
+def test_replica_parsing_and_payload_classification():
+    assert Replica("http://127.0.0.1:1234/").name == "127.0.0.1:1234"
+    assert Replica("host:80").name == "host:80"
+    with pytest.raises(ValueError):
+        Replica("no-port")
+    cls = QueryRouter.classify_payload
+    assert cls({"sql": QUERY}) == "select"
+    assert cls({"sql": "  WITH t AS (select 1) select * from t"}) == "select"
+    assert cls({"sql": "(select 1)"}) == "select"
+    assert cls({"sql": "insert into fact select * from fact"}) == "dml"
+    assert cls({"sql": "delete from fact where v < 0"}) == "dml"
+    assert cls({"template": "query3"}) == "select"
+    fp = QueryRouter.fingerprint
+    assert fp({"sql": "select  1\nfrom fact"}) == \
+        fp({"sql": "SELECT 1 FROM fact"})
+    assert fp({"template": "q", "params": {"K": 1}}) != \
+        fp({"template": "q", "params": {"K": 2}})
+    assert fp({}) is None
+
+
+# ---------------------------------------------------------------------------
+# routed round trip + fleet view
+# ---------------------------------------------------------------------------
+
+
+def test_routed_select_roundtrip_and_fleet_view(fleet):
+    _, p1, _ = fleet.replica()
+    _, p2, _ = fleet.replica()
+    router, rport = fleet.router([p1, p2])
+    status, body, _ = _post(rport, {"sql": QUERY})
+    assert status == 200 and body["status"] == "completed"
+    assert body["columns"] == ["k", "c", "s"]
+    assert body["route"]["attempts"] == 1
+    assert body["route"]["replica"] in (f"127.0.0.1:{p1}",
+                                        f"127.0.0.1:{p2}")
+    status, raw = _get(rport, "/fleet")
+    view = json.loads(raw)
+    assert status == 200 and len(view["replicas"]) == 2
+    assert all(r["healthy"] for r in view["replicas"])
+    assert view["degraded"] == {} and view["draining"] is False
+
+
+# ---------------------------------------------------------------------------
+# verdict routing: reject answered at the edge, zero worker slots
+# ---------------------------------------------------------------------------
+
+
+def test_verdict_reject_429_at_edge_consumes_no_replica_slot(fleet):
+    service, p1, _ = fleet.replica(
+        conf={"engine.plan_budget_bytes": 1024,
+              "engine.plan_budget_reject_bytes": 2048},
+        rows=1 << 16,
+    )
+    router, rport = fleet.router([p1])
+    heavy = {"sql": "select k + v from fact"}
+    for i in range(2):
+        status, body, headers = _post(rport, heavy, tenant="rej")
+        assert status == 429
+        assert body["status"] == "rejected" and body["verdict"] == "reject"
+        assert body["peak_bytes"] > 2048
+        assert body["budget_bytes"] == 1024
+        assert body["retry_after_s"] > 0
+        assert headers.get("Retry-After")
+    # the second request hit the verdict cache (one fingerprint cached)
+    assert router.fleet_snapshot()["verdict_cache_entries"] == 1
+    # the proof the edge 429 never consumed a replica worker slot: the
+    # /plan probe emits NO serve_request, so tenant "rej" never appears
+    # in the replica-side accounting at all
+    snap = M.shared_sink().status_snapshot()
+    assert "rej" not in (snap.get("tenants") or {})
+    series = M.shared_sink().registry.counter_series(
+        "nds_serve_request_total"
+    )
+    assert not any(("tenant", "rej") in labels for labels in series)
+    assert service._in_flight == 0
+    # ... while the router-edge accounting saw both rejects
+    assert snap["fleet"]["edge_rejected"] == 2
+    assert snap["fleet"]["tenants"]["rej"]["rejected"] == 2
+
+
+def test_plan_probe_is_slotless_on_the_replica(fleet):
+    service, p1, _ = fleet.replica()
+    status, body, _ = _post(p1, {"sql": QUERY}, path="/plan")
+    assert status == 200
+    assert body["kind"] == "select"
+    assert body["verdict"] in ("direct", "unknown")
+    status, body, _ = _post(
+        p1, {"sql": "insert into fact select * from fact"}, path="/plan"
+    )
+    assert status == 200
+    assert body["kind"] == "dml" and body["verdict"] is None
+    status, _, _ = _post(p1, {"template": "nope"}, path="/plan")
+    assert status == 404
+    # no admission slot, no serve_request accounting, ever
+    assert service._in_flight == 0
+    assert M.shared_sink().registry.counter_series(
+        "nds_serve_request_total"
+    ) == {}
+
+
+def test_spill_verdict_pins_to_mesh_replica(fleet):
+    _, p1, _ = fleet.replica()
+    _, p2, _ = fleet.replica()
+    router, rport = fleet.router([p1, p2], mesh_port=p2)
+    mesh = [r for r in router.replicas if r.mesh]
+    assert [r.name for r in mesh] == [f"127.0.0.1:{p2}"]
+    # _pick narrows to the mesh replica for capacity-demanding verdicts
+    for v in ("spill", "blocked", "over"):
+        assert router._pick({"verdict": v}).name == f"127.0.0.1:{p2}"
+    picked = {router._pick({"verdict": "direct"}).name for _ in range(4)}
+    assert picked == {f"127.0.0.1:{p1}", f"127.0.0.1:{p2}"}
+
+
+# ---------------------------------------------------------------------------
+# failure detection + failover
+# ---------------------------------------------------------------------------
+
+
+def test_failover_on_replica_death_marks_unhealthy(fleet):
+    _, p1, s1 = fleet.replica()
+    _, p2, _ = fleet.replica()
+    # verdict probing off so the FORWARD hop (not the /plan probe) is
+    # what discovers the death — the failover path under test
+    router, rport = fleet.router(
+        [p1, p2], conf={"engine.route_verdict_cache": 0}
+    )
+    s1.stop()  # replica death: connect refused from now on
+    dead = f"127.0.0.1:{p1}"
+    # steer the round-robin tiebreak at the dead replica first so the
+    # failover path is exercised deterministically
+    router._rr = [r.name for r in router.replicas].index(dead)
+    status, body, _ = _post(rport, {"sql": QUERY})
+    assert status == 200 and body["status"] == "completed"
+    assert body["route"]["attempts"] == 2
+    assert body["route"]["replica"] == f"127.0.0.1:{p2}"
+    view = router.fleet_snapshot()
+    by_name = {r["replica"]: r for r in view["replicas"]}
+    assert by_name[dead]["healthy"] is False
+    assert by_name[f"127.0.0.1:{p2}"]["healthy"] is True
+    # the retry left a classified metric behind
+    _, text = _get(rport, "/metrics")
+    assert 'nds_route_retry_total{reason="connect"}' in text
+    # active prober agrees: dead stays dead, live probes healthy
+    assert router.probe_replica(router.replicas[
+        [r.name for r in router.replicas].index(dead)
+    ]) is False
+    assert router.probe_replica(router.replicas[
+        [r.name for r in router.replicas].index(f"127.0.0.1:{p2}")
+    ]) is True
+
+
+def test_all_replicas_dead_fails_bounded_and_classified(fleet):
+    _, p1, s1 = fleet.replica()
+    _, p2, s2 = fleet.replica()
+    router, rport = fleet.router([p1, p2])
+    s1.stop()
+    s2.stop()
+    status, body, headers = _post(rport, {"sql": QUERY})
+    assert status == 503
+    assert body["status"] == "failed"
+    assert body["failure_kind"] == faults.IO_TRANSIENT
+    assert 2 <= body["route"]["attempts"] <= router.max_attempts
+    assert sorted(body["route"]["tried"]) == sorted(
+        [f"127.0.0.1:{p1}", f"127.0.0.1:{p2}"]
+    )
+    assert body["retry_after_s"] > 0 and headers.get("Retry-After")
+
+
+def test_retry_token_bucket_bounds_the_failover_storm(fleet):
+    _, p1, _ = fleet.replica()
+    _, p2, _ = fleet.replica()
+    router, rport = fleet.router(
+        [p1, p2],
+        conf={"engine.route_retry_burst": 1, "engine.route_retry_rate": 0},
+    )
+    # every forward hop fails like a dead replica
+    faults.install("io:route:forward:100")
+    n = 5
+    attempts = []
+    for _ in range(n):
+        status, body, _ = _post(rport, {"sql": QUERY}, tenant="storm")
+        assert status == 503 and body["status"] == "failed"
+        assert "injected" in body["error"]
+        attempts.append(body["route"]["attempts"])
+    # first attempts are free; FAILOVER retries draw from the bucket:
+    # with burst=1 and no refill the whole storm gets exactly one retry
+    assert sum(attempts) == n + 1
+    assert attempts[0] == 2 and set(attempts[1:]) == {1}
+
+
+def test_upstream_drain_propagates_with_jittered_retry_after(fleet):
+    service, p1, _ = fleet.replica()
+    router, rport = fleet.router([p1])
+    service.handle_drain()  # replica stops admitting
+    ras = []
+    status, body, headers = _post(rport, {"sql": QUERY})
+    assert status == 503 and body["status"] == "draining"
+    assert headers.get("Retry-After")
+    ras.append(body["retry_after_s"])
+    # passive detection: the 503-draining answer marked the replica
+    assert router.fleet_snapshot()["replicas"][0]["draining"] is True
+    for _ in range(4):
+        status, body, _ = _post(rport, {"sql": QUERY})
+        assert status == 503 and body["status"] == "failed"
+        assert "no healthy replica" in body["error"]
+        ras.append(body["retry_after_s"])
+    # decorrelated jitter: shed clients must not re-arrive in lockstep
+    assert len(set(ras)) >= 2
+
+
+# ---------------------------------------------------------------------------
+# fleet-wide tenant quota
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_tenant_cap_sheds_at_edge(fleet):
+    _, p1, _ = fleet.replica()
+    router, rport = fleet.router(
+        [p1], conf={"engine.route_tenant_cap": 1}
+    )
+    before = router.fleet_snapshot()["replicas"][0]["requests"]
+    assert router._tenant_enter("cap")  # one slot held fleet-wide
+    try:
+        status, body, headers = _post(rport, {"sql": QUERY}, tenant="cap")
+        assert status == 429 and body["status"] == "shed"
+        assert "fleet in-flight cap" in body["error"]
+        assert headers.get("Retry-After")
+        assert router.fleet_snapshot()["tenant_in_flight"] == {"cap": 1}
+        # shed at the edge: nothing was forwarded (not even a /plan)
+        assert router.fleet_snapshot()["replicas"][0]["requests"] == before
+        # other tenants are unaffected
+        status, _, _ = _post(rport, {"sql": QUERY}, tenant="other")
+        assert status == 200
+    finally:
+        router._tenant_leave("cap")
+    status, _, _ = _post(rport, {"sql": QUERY}, tenant="cap")
+    assert status == 200
+    fl = M.shared_sink().status_snapshot()["fleet"]
+    assert fl["tenants"]["cap"]["shed"] == 1
+    assert fl["tenants"]["cap"]["completed"] == 1
+
+
+# ---------------------------------------------------------------------------
+# DML: idempotency keys, ambiguous mid-stream death
+# ---------------------------------------------------------------------------
+
+
+def test_dml_request_key_dedups_redelivery(fleet, tmp_path):
+    path = _mini_lake(tmp_path, rows=8)
+    _, p1, _ = fleet.replica(lake_path=path)
+    dml = {"sql": "insert into fact select k, v + 1000 from fact"}
+    key = {"X-NDS-Request-Key": "k" * 16}
+    status, first, _ = _post(p1, dml, tenant="w", headers=key)
+    assert status == 200 and first["status"] == "completed"
+    assert first["rows_affected"] == 8 and first["version"] == 2
+    assert "deduped" not in first
+    # the re-delivered key answers the RECORDED envelope; nothing applies
+    status, again, _ = _post(p1, dml, tenant="w", headers=key)
+    assert status == 200 and again["deduped"] is True
+    assert again["version"] == 2 and again["rows_affected"] == 8
+    status, count, _ = _post(p1, {"sql": "select count(*) c from fact"})
+    assert count["rows"][0][0] == 16  # applied exactly once
+    # a DIFFERENT key applies again
+    status, third, _ = _post(
+        p1, dml, tenant="w", headers={"X-NDS-Request-Key": "x" * 16}
+    )
+    assert status == 200 and third["version"] == 3
+
+
+def test_dml_midstream_death_is_ambiguous_then_keyed_retry_lands(
+    fleet, tmp_path
+):
+    path = _mini_lake(tmp_path, rows=8)
+    _, p1, _ = fleet.replica(lake_path=path)
+    router, rport = fleet.router([p1])
+    # the replica's connection thread dies mid-commit with no reply: the
+    # router must NOT blind-retry a write whose outcome is unknown
+    faults.install("crash:commit:fact")
+    dml = {"sql": "insert into fact select k, v + 1000 from fact"}
+    status, body, _ = _post(rport, dml, tenant="w")
+    assert status == 503 and body["status"] == "failed"
+    assert body["failure_kind"] == faults.IO_TRANSIENT
+    assert "ambiguous" in body["error"]
+    key = body["request_key"]
+    assert key  # the router-minted idempotency key is echoed back
+    # the documented client recovery: retry WITH the key — the replica
+    # ledger + OCC statement path guarantee exactly-once application
+    status, retry, _ = _post(
+        p1, dml, tenant="w", headers={"X-NDS-Request-Key": key}
+    )
+    assert status == 200 and retry["status"] == "completed"
+    status, count, _ = _post(p1, {"sql": "select count(*) c from fact"})
+    applied_once = count["rows"][0][0]
+    # ... and a SECOND keyed delivery replays, never re-applies
+    status, replay, _ = _post(
+        p1, dml, tenant="w", headers={"X-NDS-Request-Key": key}
+    )
+    assert status == 200 and replay["deduped"] is True
+    status, count2, _ = _post(p1, {"sql": "select count(*) c from fact"})
+    assert count2["rows"][0][0] == applied_once
+    # a routed DML reply carries its minted key for exactly this recovery
+    status, ok, _ = _post(rport, dml, tenant="w")
+    assert status == 200 and ok["route"]["request_key"]
+
+
+# ---------------------------------------------------------------------------
+# coordinator loss: the degraded-DML circuit
+# ---------------------------------------------------------------------------
+
+
+def test_coordinator_loss_degrades_dml_keeps_selects(fleet, tmp_path):
+    path = _mini_lake(tmp_path, rows=8)
+    service, p1, _ = fleet.replica(lake_path=path)
+    router, rport = fleet.router(
+        [p1], conf={"engine.route_catalog_cooldown_s": 0.2}
+    )
+    dml = {"sql": "insert into fact select k, v + 1000 from fact"}
+    real_run_dml = service._run_dml
+
+    def unreachable(sql_text, tenant, rid, t0, qlabel, request_key=None):
+        return service._reply(500, {
+            "request_id": rid, "tenant": tenant, "status": "failed",
+            "failure_kind": faults.IO_TRANSIENT,
+            "error": "catalog unreachable at http://127.0.0.1:9 "
+                     "(injected: coordinator down)",
+        })
+
+    service._run_dml = unreachable
+    status, body, _ = _post(rport, dml, tenant="w")
+    assert status == 500 and body["failure_kind"] == faults.IO_TRANSIENT
+    # the circuit opened; /statusz names the degraded capability
+    assert "dml" in router.fleet_snapshot()["degraded"]
+    reqs = router.fleet_snapshot()["replicas"][0]["requests"]
+    # further DML fast-fails AT THE EDGE (no replica round trip, no
+    # per-request timeout), classified retryable
+    status, body, _ = _post(rport, dml, tenant="w")
+    assert status == 503 and body["status"] == "failed"
+    assert body["failure_kind"] == faults.IO_TRANSIENT
+    assert body["degraded"] == "dml"
+    assert router.fleet_snapshot()["replicas"][0]["requests"] == reqs
+    # pinned reads keep serving the whole time
+    status, sel, _ = _post(rport, {"sql": QUERY})
+    assert status == 200 and sel["status"] == "completed"
+    # coordinator returns: after the cooldown ONE half-open probe rides
+    # through; its success closes the circuit
+    service._run_dml = real_run_dml
+    time.sleep(0.25)
+    status, body, _ = _post(rport, dml, tenant="w")
+    assert status == 200 and body["status"] == "completed"
+    assert router.fleet_snapshot()["degraded"] == {}
+
+
+# ---------------------------------------------------------------------------
+# fleet lifecycle: rolling drain + reload with zero dropped requests
+# ---------------------------------------------------------------------------
+
+
+def test_rolling_fleet_reload_drops_nothing(fleet):
+    _, p1, _ = fleet.replica()
+    _, p2, _ = fleet.replica()
+    router, rport = fleet.router([p1, p2])
+    stop = threading.Event()
+    results = []
+
+    def client():
+        while not stop.is_set():
+            status, body, _ = _post(rport, {"sql": POINT}, tenant="roll")
+            results.append((status, body.get("status")))
+
+    t = threading.Thread(target=client, daemon=True)
+    t.start()
+    time.sleep(0.2)  # traffic in flight before the roll starts
+    status, body, _ = _post(rport, {}, path="/fleet/reload")
+    stop.set()
+    t.join(30)
+    assert status == 200
+    assert body["ok"] is True and body["rolled"] == 2
+    for rec in body["replicas"]:
+        assert rec["drained"] is True and rec["reloaded"] is True
+    # ZERO dropped client requests across the whole roll
+    assert results and all(s == 200 for s, _ in results)
+    # both replicas are back in rotation (reload re-opened admission)
+    assert _get(p1, "/healthz")[0] == 200
+    assert _get(p2, "/healthz")[0] == 200
+    view = router.fleet_snapshot()
+    assert all(not r["draining"] for r in view["replicas"])
+    # the router itself drains via its own verb
+    status, body, _ = _post(rport, {}, path="/drain")
+    assert status == 200 and router.draining is True
+    status, _, _ = _post(rport, {"sql": POINT})
+    assert status == 503
+
+
+# ---------------------------------------------------------------------------
+# observability: the router hop joins the request's trace
+# ---------------------------------------------------------------------------
+
+
+def test_route_hop_joins_the_request_trace(fleet, tmp_path):
+    from nds_tpu.obs import reader as R
+
+    trace = tmp_path / "trace"
+    _, p1, _ = fleet.replica(conf={"engine.trace_dir": str(trace)})
+    router, rport = fleet.router([p1], trace_dir=trace)
+    status, body, _ = _post(rport, {"sql": QUERY}, tenant="tr")
+    assert status == 200
+    rid = body["request_id"]
+    evs = R.read_events(str(trace), strict=True)
+    assert R.validate_events(evs) == []
+    mine = [e for e in evs if e.get("trace_id") == rid]
+    kinds = {e["kind"] for e in mine}
+    # ONE trace_id spans the router hop AND the replica's execution
+    assert {"route_request", "serve_request", "query_span"} <= kinds
+    route_ev = [e for e in mine if e["kind"] == "route_request"][0]
+    assert route_ev["tenant"] == "tr"
+    assert route_ev["status"] == "completed"
+    assert route_ev["attempts"] == 1
+    assert route_ev["replica"] == f"127.0.0.1:{p1}"
+    assert route_ev["queue_ms"] >= 0 and route_ev["forward_ms"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# CLI construction seams
+# ---------------------------------------------------------------------------
+
+
+def test_cli_build_router_wires_listener_and_fleet_provider():
+    import argparse
+
+    from nds_tpu.cli.route import build_router
+
+    args = argparse.Namespace(
+        replica=["127.0.0.1:9", "127.0.0.1:11"], port=0,
+        mesh_replica="127.0.0.1:11", property_file=None,
+    )
+    router, server = build_router(args)
+    try:
+        assert server.port > 0
+        assert [r.mesh for r in router.replicas] == [False, True]
+        # /statusz's fleet section is the router's live view
+        fl = M.shared_sink().status_snapshot()["fleet"]
+        assert len(fl["replicas"]) == 2
+        assert fl["tenant_cap"] == router.tenant_cap
+    finally:
+        router.close()
+
+
+def test_cli_serve_aot_cache_dir_flag(tmp_path, monkeypatch):
+    import argparse
+
+    from nds_tpu.cli.serve import build_service
+
+    monkeypatch.setenv("NDS_AOT_CACHE_DIR", "0")  # restored at teardown
+    wh = tmp_path / "wh"
+    wh.mkdir()
+    LakehouseTable.create(str(wh / "store_sales"), _fact_table(4))
+    aot = str(tmp_path / "aot")
+    args = argparse.Namespace(
+        warehouse_path=str(wh), input_format="lakehouse", port=0,
+        property_file=None, stream=None, job_dir=None, floats=False,
+        aot_cache_dir=aot,
+    )
+    service, server = build_service(args)
+    try:
+        import os
+
+        assert os.environ["NDS_AOT_CACHE_DIR"] == aot
+        # the session armed the shared cache — N replicas pointed at one
+        # warmed dir deserialize instead of compiling
+        assert service.session.aot_cache is not None
+    finally:
+        service.close()
+
+
+def test_cache_warm_fleet_flag_accepted(tmp_path):
+    from nds_tpu.cli import cache as cache_cli
+
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    rc = cache_cli.main([
+        "warm", str(empty), "nope.sql",
+        "--cache_dir", str(tmp_path / "c"), "--fleet", "--json",
+    ])
+    assert rc == 2  # parsed fine; failed on the empty warehouse

@@ -1,0 +1,390 @@
+#!/usr/bin/env python
+"""Serving-fleet chaos gate (ci/tier1-check).
+
+Four acceptance checks for the router's robustness fronts, over REAL
+replica processes (SIGKILL means SIGKILL):
+
+1. **Failover on replica death mid-query** — a replica holding a SELECT
+   open (hang fault at `replica:kill`) is SIGKILLed mid-stream; the
+   request must complete on the surviving replica with exactly one
+   classified retry, and ONE trace_id must span the router's retry
+   evidence and the surviving replica's execution.
+2. **Retry-storm containment** — with every forward hop failing
+   (`io:route:forward`), N concurrent clients must all fail classified
+   503 with total upstream attempts bounded by N + the retry-token
+   burst, and jittered Retry-After values (no lockstep re-arrival).
+3. **Rolling /fleet/reload** — drain + reload rolls across the replicas
+   under continuous client traffic with ZERO dropped requests.
+4. **Coordinator loss** — SIGKILL the tcp lakehouse coordinator: DML
+   fails classified-retryable and opens the router's degraded-DML
+   circuit (further DML fast-fails AT THE EDGE, no replica round trip)
+   while pinned reads keep serving; restarting the coordinator on the
+   same port closes the circuit through the half-open probe.
+
+Usage: python tools/fleet_check.py [--keep]
+"""
+
+import argparse
+import json
+import os
+import re
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+import pyarrow as pa  # noqa: E402
+
+from nds_tpu import faults  # noqa: E402
+from nds_tpu.lakehouse.table import LakehouseTable  # noqa: E402
+from nds_tpu.obs import trace as obs_trace  # noqa: E402
+from nds_tpu.serve.router import QueryRouter  # noqa: E402
+
+QUERY = "select k, count(*) c, sum(v) s from fact group by k order by k"
+POINT = "select k, v from fact where v = 3 limit 1"
+
+#: one replica process: a real Session + QueryService behind the real
+#: process-wide listener (conf/fault-spec/trace dir arrive via env)
+_REPLICA_SCRIPT = """
+import sys, threading
+sys.path.insert(0, {repo!r})
+from nds_tpu.engine.session import Session
+from nds_tpu.obs import metrics as M
+from nds_tpu.serve.service import QueryService
+session = Session(conf={{"engine.metrics_port": 0}})
+session.register_lakehouse("fact", sys.argv[1])
+service = QueryService(session)
+server = M.active_server()
+assert server is not None, "replica listener failed to bind"
+server.attach_app(service)
+print(f"replica: listening on 127.0.0.1:{{server.port}}", flush=True)
+threading.Event().wait()
+"""
+
+
+def _fact_table(rows=64):
+    return pa.table({
+        "k": pa.array(np.arange(rows) % 8, type=pa.int64()),
+        "v": pa.array(np.arange(rows), type=pa.int64()),
+    })
+
+
+def _check(ok, label):
+    print(f"  {'OK ' if ok else 'FAIL'} {label}")
+    if not ok:
+        raise SystemExit(f"fleet_check: FAILED: {label}")
+
+
+def _env(**extra):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "NDS_METRICS_HOST": "127.0.0.1"}
+    env.pop("NDS_FAULT_SPEC", None)
+    env.update(extra)
+    return env
+
+
+def _wait_port(proc, pattern, what):
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        m = re.search(pattern, line)
+        if m:
+            return int(m.group(1))
+    proc.kill()
+    raise SystemExit(f"fleet_check: {what} never announced a port")
+
+
+def _spawn_replica(table_path, fault_spec=None, extra_env=None):
+    env = _env(**(extra_env or {}))
+    if fault_spec:
+        env["NDS_FAULT_SPEC"] = fault_spec
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         _REPLICA_SCRIPT.format(repo=REPO), table_path],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+    return proc, _wait_port(proc, r"listening on [^:]+:(\d+)", "replica")
+
+
+def _spawn_coordinator(warehouse, port=0):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "nds_tpu.cli.catalog", warehouse,
+         "--port", str(port)],
+        env=_env(), cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+    return proc, _wait_port(
+        proc, r"coordinating .* on [^:]+:(\d+)", "coordinator"
+    )
+
+
+def _mk_router(ports, trace_dir=None, **knobs):
+    conf = {
+        "engine.route_health_interval_s": 0,
+        "engine.route_backoff_base_s": 0.01,
+        "engine.route_backoff_cap_s": 0.05,
+    }
+    conf.update(knobs)
+    tracer = None
+    if trace_dir:
+        tracer = obs_trace.tracer_from_conf(
+            {"engine.trace_dir": trace_dir}, app_id="nds-route"
+        )
+    return QueryRouter(
+        [f"127.0.0.1:{p}" for p in ports], conf=conf, tracer=tracer
+    )
+
+
+def _route(router, payload, tenant="default"):
+    status, _ctype, body, _hdrs = router.handle_query(payload, tenant)
+    return status, json.loads(body)
+
+
+def check_failover_sigkill(workdir, table, trace, surviving_port):
+    """SIGKILL a replica mid-SELECT: one classified retry, traceable."""
+    print("failover: SIGKILL a replica mid-query -> one classified retry")
+    victim, vport = _spawn_replica(
+        table, fault_spec="hang:replica:kill:120",
+        extra_env={"NDS_TRACE_DIR": trace},
+    )
+    router = _mk_router(
+        [vport, surviving_port], trace_dir=trace,
+        **{"engine.route_verdict_cache": 0},  # the FORWARD hop discovers
+    )
+    try:
+        router._rr = 0  # deterministic: the victim is picked first
+        box = {}
+
+        def req():
+            box["resp"] = _route(router, {"sql": QUERY}, tenant="chaos")
+
+        t = threading.Thread(target=req, daemon=True)
+        t.start()
+        time.sleep(2.0)  # inside the victim's 120s replica:kill hang
+        victim.send_signal(signal.SIGKILL)
+        victim.wait(timeout=30)
+        t.join(90)
+        _check("resp" in box, "request returned after the SIGKILL")
+        status, body = box["resp"]
+        _check(status == 200 and body["status"] == "completed",
+               "query survived the replica death (200)")
+        _check(body["route"]["attempts"] == 2,
+               "exactly ONE failover retry (attempts=2)")
+        _check(body["route"]["replica"] == f"127.0.0.1:{surviving_port}",
+               "answered by the surviving replica")
+        rid = body["request_id"]
+        from nds_tpu.obs import reader as R
+
+        evs = R.read_events(trace, strict=False)
+        mine = [e for e in evs if e.get("trace_id") == rid]
+        kinds = {e.get("kind") for e in mine}
+        _check({"route_request", "route_retry", "serve_request"} <= kinds,
+               "ONE trace_id spans router retry + surviving replica")
+        retry = [e for e in mine if e.get("kind") == "route_retry"][0]
+        _check(retry["reason"] == "midstream"
+               and retry["replica"] == f"127.0.0.1:{vport}",
+               "retry classified mid-stream against the killed replica")
+    finally:
+        router.close()
+        if victim.poll() is None:
+            victim.kill()
+
+
+def check_retry_storm(ports):
+    """Every forward hop fails: the token bucket caps amplification."""
+    print("retry storm: token bucket caps fleet amplification")
+    burst = 2
+    faults.install("io:route:forward:1000")
+    router = _mk_router(ports, **{
+        "engine.route_retry_burst": burst, "engine.route_retry_rate": 0,
+    })
+    try:
+        n = 6
+        results = []
+        lock = threading.Lock()
+
+        def client():
+            r = _route(router, {"sql": POINT}, tenant="storm")
+            with lock:
+                results.append(r)
+
+        threads = [threading.Thread(target=client) for _ in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        _check(len(results) == n and all(s == 503 for s, _ in results),
+               f"all {n} storm requests failed fast (503, none hung)")
+        _check(all(b["failure_kind"] == faults.IO_TRANSIENT
+                   for _, b in results),
+               "failures classified io_transient")
+        attempts = sum(b["route"]["attempts"] for _, b in results)
+        _check(attempts <= n + burst,
+               f"total attempts {attempts} <= requests({n}) + burst({burst})")
+        ras = {b["retry_after_s"] for _, b in results}
+        _check(len(ras) >= 2,
+               "Retry-After jittered (no lockstep re-arrival)")
+    finally:
+        faults.reset()
+        router.close()
+
+
+def check_rolling_reload(ports):
+    """Drain + reload rolls the fleet under load; nothing drops."""
+    print("rolling /fleet/reload: zero dropped requests under load")
+    router = _mk_router(ports)
+    try:
+        stop = threading.Event()
+        results = []
+        lock = threading.Lock()
+
+        def client():
+            while not stop.is_set():
+                r = _route(router, {"sql": POINT}, tenant="roll")
+                with lock:
+                    results.append(r)
+
+        threads = [threading.Thread(target=client, daemon=True)
+                   for _ in range(2)]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)  # traffic in flight before the roll starts
+        status, _ctype, body, _h = router.handle_fleet_reload()
+        roll = json.loads(body)
+        stop.set()
+        for t in threads:
+            t.join(60)
+        _check(status == 200 and roll["ok"]
+               and roll["rolled"] == len(ports),
+               "every replica drained and reloaded")
+        bad = [(s, b.get("status")) for s, b in results if s != 200]
+        _check(bool(results) and not bad,
+               f"zero dropped requests across the roll "
+               f"({len(results)} served{', bad: ' + repr(bad[:3]) if bad else ''})")
+        view = router.fleet_snapshot()
+        _check(all(not r["draining"] for r in view["replicas"]),
+               "replicas back in rotation after the roll")
+    finally:
+        router.close()
+
+
+def check_coordinator_loss(workdir):
+    """Kill the tcp catalog coordinator: DML degrades at the edge,
+    pinned reads keep serving, restart closes the circuit."""
+    print("coordinator loss: DML degrades at the edge, reads keep serving")
+    wh = os.path.join(workdir, "wh-coord")
+    os.makedirs(wh)
+    table = os.path.join(wh, "fact")
+    LakehouseTable.create(table, _fact_table())
+    coord, cport = _spawn_coordinator(wh)
+    replica, rport = _spawn_replica(table, extra_env={
+        "NDS_LAKE_CATALOG": f"http://127.0.0.1:{cport}",
+        "NDS_LAKE_CATALOG_TIMEOUT_S": "1",
+        "NDS_LAKE_CATALOG_POLL_S": "0.2",
+    })
+    router = _mk_router(
+        [rport], **{"engine.route_catalog_cooldown_s": 1.0}
+    )
+    dml = {"sql": "insert into fact select k, v + 1000 from fact "
+                  "where v < 4"}
+    coord2 = None
+    try:
+        status, body = _route(router, {"sql": QUERY})
+        _check(status == 200, "SELECT serves with the coordinator up")
+        status, body = _route(router, dml, tenant="w")
+        _check(status == 200 and body["status"] == "completed",
+               "DML commits through the coordinator")
+        coord.send_signal(signal.SIGKILL)
+        coord.wait(timeout=30)
+        status, body = _route(router, dml, tenant="w")
+        _check(status >= 500
+               and body.get("failure_kind") == faults.IO_TRANSIENT
+               and "catalog unreachable" in str(body.get("error", "")),
+               "coordinator-down DML fails classified-retryable")
+        _check("dml" in router.fleet_snapshot()["degraded"],
+               "degraded capability named in the fleet view")
+        reqs = router.fleet_snapshot()["replicas"][0]["requests"]
+        status, body = _route(router, dml, tenant="w")
+        _check(status == 503 and body.get("degraded") == "dml",
+               "further DML fast-fails at the edge (503 + degraded)")
+        _check(router.fleet_snapshot()["replicas"][0]["requests"] == reqs,
+               "edge fast-fail consumed no replica round trip")
+        status, body = _route(router, {"sql": QUERY})
+        _check(status == 200,
+               "pinned reads keep serving through the outage")
+        # the coordinator comes back on the SAME port; the half-open
+        # probe rides through after the cooldown and closes the circuit
+        coord2, _ = _spawn_coordinator(wh, port=cport)
+        deadline = time.monotonic() + 90
+        ok = False
+        while time.monotonic() < deadline:
+            status, body = _route(router, dml, tenant="w")
+            if status == 200 and body.get("status") == "completed":
+                ok = True
+                break
+            time.sleep(0.5)
+        _check(ok, "half-open probe closed the circuit after restart")
+        _check(router.fleet_snapshot()["degraded"] == {},
+               "degraded capability cleared")
+    finally:
+        router.close()
+        for p in (coord, coord2, replica):
+            if p is not None and p.poll() is None:
+                p.terminate()
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the scratch dir for inspection")
+    args = ap.parse_args()
+    workdir = tempfile.mkdtemp(prefix="nds-fleet-check-")
+    t0 = time.perf_counter()
+    trace = os.path.join(workdir, "trace")
+    wh = os.path.join(workdir, "wh")
+    os.makedirs(wh)
+    table = os.path.join(wh, "fact")
+    LakehouseTable.create(table, _fact_table())
+    b = c = None
+    try:
+        b, bport = _spawn_replica(
+            table, extra_env={"NDS_TRACE_DIR": trace}
+        )
+        c, cport = _spawn_replica(
+            table, extra_env={"NDS_TRACE_DIR": trace}
+        )
+        check_failover_sigkill(workdir, table, trace, bport)
+        check_retry_storm([bport, cport])
+        check_rolling_reload([bport, cport])
+    finally:
+        for p in (b, c):
+            if p is not None and p.poll() is None:
+                p.terminate()
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+    check_coordinator_loss(workdir)
+    if args.keep:
+        print(f"fleet_check: scratch kept at {workdir}")
+    else:
+        shutil.rmtree(workdir, ignore_errors=True)
+    print(f"fleet_check: OK ({time.perf_counter() - t0:.1f}s)")
+
+
+if __name__ == "__main__":
+    main()

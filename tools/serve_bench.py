@@ -26,6 +26,14 @@ claim.
     python tools/serve_bench.py --smoke     # the CI gate: a short run
         that must finish with zero 5xx, zero snapshot violations, zero
         admission-rejected requests, and p99 under a generous bound
+    python tools/serve_bench.py --smoke --fleet [--fleet_replicas 2]
+        # the same mixed load sent THROUGH the fleet router over N real
+        # replica processes: fleet QPS x p99 from the router-side
+        # histogram, plus an edge-reject probe (a cross-join whose
+        # modeled peak is beyond the admission reject line) that must
+        # come back 429 from the ROUTER with the probe tenant absent
+        # from every replica's /statusz — the proof an edge-rejected
+        # request never consumed a replica worker slot
 
 Env: NDS_SERVE_BENCH_DIR (default /tmp/nds_serve_bench) for the
 warehouse; the raw SF0.01 set is shared with the test suite's
@@ -74,6 +82,44 @@ POINT_SQL = (
 #: counts are equal — a torn (non-snapshot) read shows unequal counts
 CONSISTENCY_SQL = "select k, count(*) c from serve_dm group by k order by k"
 DM_SQL = "insert into serve_dm select k, v + 1000 from serve_dm where v < 8"
+
+#: the edge-reject probe: a full-width self-join + sort whose modeled
+#: peak (~32 MB at SF0.01) is beyond the fleet replicas' admission
+#: reject line (_FLEET_BUDGET_PROPS) with no windowing seam — the
+#: router's /plan verdict probe sees `reject` and answers 429 at the
+#: edge without a replica ever admitting (or even accounting) it
+FLEET_REJECT_SQL = """
+select a.*, b.* from store_sales a
+join store_sales b on a.ss_ticket_number = b.ss_ticket_number
+order by a.ss_ticket_number
+"""
+
+#: fleet replicas run with budget lines sized so the whole smoke mix is
+#: verdict `direct` (heaviest shape models ~4.6 MB) while the reject
+#: probe is beyond the reject line even windowed — measured values, see
+#: the FLEET_REJECT_SQL note
+_FLEET_BUDGET_PROPS = (
+    f"engine.plan_budget_bytes={8 << 20}\n"
+    f"engine.plan_budget_reject_bytes={16 << 20}\n"
+)
+
+#: one fleet replica: the real CLI construction path in a child process
+#: (build_service + the serve_dm registration _start_service does)
+_REPLICA_SCRIPT = """
+import argparse, sys, threading
+sys.path.insert(0, {repo!r})
+from nds_tpu.cli.serve import build_service
+ns = argparse.Namespace(
+    warehouse_path=sys.argv[1], input_format="lakehouse", port=0,
+    property_file=sys.argv[3], stream=None, job_dir=None, floats=False,
+    aot_cache_dir=None,
+)
+service, server = build_service(ns)
+service.session.register_lakehouse("serve_dm", sys.argv[2])
+service.writer_session.register_lakehouse("serve_dm", sys.argv[2])
+print(f"replica: listening on 127.0.0.1:{{server.port}}", flush=True)
+threading.Event().wait()
+"""
 
 
 def _ensure_assets():
@@ -128,6 +174,74 @@ def _start_service(wh, dm_path, workers=None, job_dir=None):
     service.session.register_lakehouse("serve_dm", dm_path)
     service.writer_session.register_lakehouse("serve_dm", dm_path)
     return service, server
+
+
+def _spawn_replica(wh, dm_path, property_file):
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _REPLICA_SCRIPT.format(repo=REPO),
+         wh, dm_path, property_file],
+        env={**os.environ, "NDS_METRICS_HOST": "127.0.0.1"},
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        m = re.search(r"listening on [^:]+:(\d+)", line)
+        if m:
+            return proc, int(m.group(1))
+    proc.kill()
+    raise SystemExit("serve_bench: fleet replica never announced a port")
+
+
+def _start_fleet(wh, dm_path, n):
+    """N real replica processes behind an in-process QueryRouter on its
+    own listener; clients talk HTTP to the router, never a replica."""
+    from nds_tpu.obs import metrics as obs_metrics
+    from nds_tpu.obs import trace as obs_trace
+    from nds_tpu.serve.router import QueryRouter
+
+    pf = os.path.join(BASE, "fleet.properties")
+    with open(pf, "w") as f:
+        f.write(_FLEET_BUDGET_PROPS)
+    procs, ports = [], []
+    for _ in range(n):
+        proc, port = _spawn_replica(wh, dm_path, pf)
+        procs.append(proc)
+        ports.append(port)
+    obs_metrics.reset_shared()
+    tracer = obs_trace.tracer_from_conf(
+        {"engine.metrics_port": 0}, app_id="nds-route"
+    )
+    router = QueryRouter(
+        [f"127.0.0.1:{p}" for p in ports], conf={}, tracer=tracer
+    )
+    server = obs_metrics.active_server()
+    if server is None:
+        raise SystemExit("serve_bench: router listener failed to bind")
+    server.attach_app(router)
+    obs_metrics.shared_sink().set_fleet_provider(router.fleet_snapshot)
+    return procs, ports, router, server
+
+
+def _stop_fleet(procs, router):
+    router.close()
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def _get_statusz(port):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/statusz", timeout=10
+    ) as r:
+        return json.loads(r.read().decode())
 
 
 def _post(port, payload, tenant, timeout=300.0):
@@ -185,10 +299,17 @@ def _scrape_hist_p99(port, family="nds_serve_request_dur_ms"):
     return None, total, text
 
 
-def run_bench(clients=4, duration_s=30.0, smoke=False, workers=None):
-    """The closed-loop run; returns the report dict."""
+def run_bench(clients=4, duration_s=30.0, smoke=False, workers=None,
+              fleet=0):
+    """The closed-loop run; returns the report dict. `fleet=N` sends the
+    same mix through a QueryRouter over N replica processes instead of
+    one in-process service."""
     wh, dm_path = _ensure_assets()
-    service, server = _start_service(wh, dm_path, workers=workers)
+    if fleet:
+        procs, rports, router, server = _start_fleet(wh, dm_path, fleet)
+        service = None
+    else:
+        service, server = _start_service(wh, dm_path, workers=workers)
     port = server.port
     results = []  # (class, tenant, status, ms, violation)
     results_lock = threading.Lock()
@@ -233,8 +354,10 @@ def run_bench(clients=4, duration_s=30.0, smoke=False, workers=None):
             if smoke and n >= smoke_requests:
                 return
 
-    print(f"serve_bench: {clients} closed-loop clients against "
-          f":{port} ({service.workers} workers)", flush=True)
+    what = (f"the fleet router over {fleet} replica(s)" if fleet
+            else f":{port} ({service.workers} workers)")
+    print(f"serve_bench: {clients} closed-loop clients against {what}",
+          flush=True)
     wall_start = time.perf_counter()
     threads = [
         threading.Thread(target=client, args=(i,), daemon=True)
@@ -242,9 +365,24 @@ def run_bench(clients=4, duration_s=30.0, smoke=False, workers=None):
     ]
     for t in threads:
         t.start()
+    # the edge-reject probes ride WHILE the mix is in flight, so "never
+    # consumed a worker slot" is measured under real contention; kept
+    # out of `results` — these 429s are the deliberate success case
+    probe_results = []
+    if fleet:
+        for _ in range(3):
+            try:
+                probe_results.append(
+                    _post(port, {"sql": FLEET_REJECT_SQL}, "edge-probe",
+                          timeout=120.0)
+                )
+            except OSError:
+                probe_results.append((599, {}))
     scraped_p99 = None
     scraped_total = 0
     exposition = None
+    hist_family = ("nds_route_request_dur_ms" if fleet
+                   else "nds_serve_request_dur_ms")
     deadline = time.monotonic() + (duration_s if not smoke else 600)
     # mid-run scrape loop: the server-side histogram must be live WHILE
     # clients are still sending (that is the "scraped mid-run" contract)
@@ -252,7 +390,7 @@ def run_bench(clients=4, duration_s=30.0, smoke=False, workers=None):
         if time.monotonic() >= deadline and not smoke:
             stop.set()
         try:
-            p99, total, text = _scrape_hist_p99(port)
+            p99, total, text = _scrape_hist_p99(port, family=hist_family)
             if total:
                 scraped_p99, scraped_total, exposition = p99, total, text
         except OSError:
@@ -263,9 +401,14 @@ def run_bench(clients=4, duration_s=30.0, smoke=False, workers=None):
     wall_s = time.perf_counter() - wall_start
     # post-run churn check: the DM table's final state is itself one
     # consistent snapshot
-    final = service.session.sql(CONSISTENCY_SQL).collect().to_pylist()
-    final_counts = {r["k"]: r["c"] for r in final}
-    final_ok = len(set(final_counts.values())) == 1
+    if fleet:
+        status, body = _post(port, {"sql": CONSISTENCY_SQL}, "final")
+        final_counts = {r[0]: r[1] for r in (body.get("rows") or [])}
+        final_ok = status == 200 and len(set(final_counts.values())) == 1
+    else:
+        final = service.session.sql(CONSISTENCY_SQL).collect().to_pylist()
+        final_counts = {r["k"]: r["c"] for r in final}
+        final_ok = len(set(final_counts.values())) == 1
     from nds_tpu.obs.metrics import validate_exposition
 
     exposition_problems = (
@@ -283,7 +426,7 @@ def run_bench(clients=4, duration_s=30.0, smoke=False, workers=None):
     ok_times = [r[3] for r in results if r[2] == 200]
     report = {
         "clients": clients,
-        "workers": service.workers,
+        "workers": None if fleet else service.workers,
         "wall_s": round(wall_s, 2),
         "requests": len(results),
         "completed": len(ok_times),
@@ -300,7 +443,37 @@ def run_bench(clients=4, duration_s=30.0, smoke=False, workers=None):
         "scraped_requests": scraped_total,
         "exposition_valid": exposition_problems == [],
     }
-    service.close()
+    if fleet:
+        # the never-consumed-a-slot proof: the probe tenant must be 429
+        # at the router AND absent from every replica's own /statusz
+        # accounting (the /plan verdict probe is slotless by contract)
+        leaked = []
+        for rp in rports:
+            try:
+                tenants = _get_statusz(rp).get("tenants") or {}
+            except OSError:
+                tenants = {}
+            if "edge-probe" in tenants:
+                leaked.append(rp)
+        from nds_tpu.obs import metrics as obs_metrics
+
+        fleet_acct = (
+            obs_metrics.shared_sink().status_snapshot().get("fleet") or {}
+        )
+        report["fleet"] = {
+            "replicas": fleet,
+            "router_view": router.fleet_snapshot()["replicas"],
+            "edge_probe_statuses": [s for s, _ in probe_results],
+            "edge_probe_rejected": all(
+                s == 429 and b.get("status") == "rejected"
+                for s, b in probe_results
+            ),
+            "edge_rejected_total": fleet_acct.get("edge_rejected", 0),
+            "slot_leak_replicas": leaked,
+        }
+        _stop_fleet(procs, router)
+    else:
+        service.close()
     from nds_tpu.obs import metrics as obs_metrics
 
     obs_metrics.reset_shared()
@@ -326,10 +499,20 @@ def main(argv=None) -> int:
         "--smoke_p99_ms", type=float, default=120_000.0,
         help="generous smoke p99 bound (CPU cold compiles included)",
     )
+    parser.add_argument(
+        "--fleet", action="store_true",
+        help="send the mix through the fleet router over real replica "
+        "processes; adds the edge-reject slot-leak probe",
+    )
+    parser.add_argument(
+        "--fleet_replicas", type=int, default=2,
+        help="replica process count for --fleet (default 2)",
+    )
     args = parser.parse_args(argv)
     report = run_bench(
         clients=args.clients, duration_s=args.duration, smoke=args.smoke,
         workers=args.workers,
+        fleet=args.fleet_replicas if args.fleet else 0,
     )
     print(json.dumps(report, indent=2, default=str))
     if args.out:
@@ -359,6 +542,20 @@ def main(argv=None) -> int:
             )
         if not report["exposition_valid"]:
             problems.append("/metrics exposition invalid or never scraped")
+        fl = report.get("fleet")
+        if fl:
+            if not fl["edge_probe_rejected"]:
+                problems.append(
+                    f"edge-reject probe not 429/rejected at the router "
+                    f"(statuses {fl['edge_probe_statuses']})"
+                )
+            if fl["slot_leak_replicas"]:
+                problems.append(
+                    f"edge-rejected tenant leaked into replica worker "
+                    f"accounting on port(s) {fl['slot_leak_replicas']}"
+                )
+            if fl["edge_rejected_total"] < len(fl["edge_probe_statuses"]):
+                problems.append("router edge_rejected counter undercounts")
         if problems:
             print("serve_bench --smoke FAILED: " + "; ".join(problems),
                   file=sys.stderr)
