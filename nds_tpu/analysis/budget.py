@@ -581,6 +581,9 @@ class PlanBudget:
     #: unpredicted device OOM can retry through the spill pool
     spillable: bool = False
     spill_partitions: Optional[int] = None  # set when verdict == spill
+    #: nodes whose static row estimate a recorded actual replaced
+    #: (engine.plan_feedback=on; 0 = the pure static model)
+    feedback_overrides: int = 0
 
     def table(self, limit: int = 0) -> str:
         """Human-readable per-node estimate table (explain --budget)."""
@@ -643,7 +646,7 @@ class PlanBudgeter:
 
     def __init__(self, catalog=None, stats: Optional[CatalogStats] = None,
                  budget_bytes: Optional[int] = None, windowed: bool = False,
-                 mesh_devices: Optional[int] = None):
+                 mesh_devices: Optional[int] = None, feedback=None):
         from .verifier import PlanVerifier, _count_plan_refs
 
         self.stats = stats or CatalogStats(catalog)
@@ -666,6 +669,12 @@ class PlanBudgeter:
         #: statically derived window rows per blocked aggregate modeled in
         #: windowed mode (plan window = min over these)
         self.blocked_windows: list = []
+        #: measured-cardinality overrides (engine.plan_feedback=on):
+        #: {id(node): recorded actual rows} from the FeedbackStore. None
+        #: (or empty) keeps the static model byte-identical; applied
+        #: overrides are collected for the plan_feedback event
+        self.feedback = feedback or None
+        self.feedback_applied: list = []
 
     # -- entry ----------------------------------------------------------
     def run(self, root: P.PlanNode) -> int:
@@ -704,6 +713,24 @@ class PlanBudgeter:
     def _finish(self, node, rows, width, alloc, children,
                 live=None, blocked=False, sharded=False) -> NodeEstimate:
         rows = max(int(rows), 0)
+        fb = self.feedback.get(id(node)) if self.feedback else None
+        if fb is not None:
+            # measured actual overrides the static estimate (clamped:
+            # the recorded value is the observed MAXIMUM, so the new
+            # estimate is never below anything this node has produced).
+            # Allocation scales with the capacity bucket ratio — the
+            # per-rule alloc terms are cap-proportional, and children's
+            # own overrides were already applied bottom-up
+            fb = max(int(fb), 0)
+            if fb != rows:
+                old_cap = bucket_cap(max(rows, 1))
+                new_cap = bucket_cap(max(fb, 1))
+                if new_cap != old_cap:
+                    alloc = int(alloc * (new_cap / old_cap))
+                    if live is not None:
+                        live = int(live * (new_cap / old_cap))
+                rows = fb
+                self.feedback_applied.append(node)
         cap = bucket_cap(max(rows, 1))
         live_b = (
             live if live is not None else self._div(cap * width, sharded)
@@ -1073,6 +1100,7 @@ def analyze_plan(
     budget_bytes: Optional[int] = None,
     reject_bytes: Optional[int] = None,
     mesh_devices: Optional[int] = None,
+    feedback=None,
 ) -> PlanBudget:
     """Analyze one bound + rewritten plan against a catalog (or the TPC-DS
     scale model when `scale_factor` is given): a direct-path pass, a
@@ -1091,10 +1119,15 @@ def analyze_plan(
     divide by the mesh width, replicated relations are charged on every
     chip, and the verdict answers "does each chip's share fit its HBM
     budget" — the admission question a mesh session (and serve mode on
-    one) actually has."""
+    one) actually has.
+
+    `feedback` ({id(node): recorded actual rows}, engine.plan_feedback=on)
+    replaces static per-node row estimates with measured cardinalities
+    before verdict folding; None (the default, and every pre-feedback
+    caller) is byte-identical to the static model."""
     stats = CatalogStats(catalog, scale_factor)
     direct = PlanBudgeter(catalog, stats, budget_bytes, windowed=False,
-                          mesh_devices=mesh_devices)
+                          mesh_devices=mesh_devices, feedback=feedback)
     peak = direct.run(plan)
     budget = direct.budget_bytes
     reject_line = (
@@ -1105,7 +1138,7 @@ def analyze_plan(
     window_rows = None
     if has_blocked:
         win = PlanBudgeter(catalog, stats, budget_bytes, windowed=True,
-                           mesh_devices=mesh_devices)
+                           mesh_devices=mesh_devices, feedback=feedback)
         peak_blocked = min(win.run(plan), peak)
         if win.blocked_windows:
             window_rows = min(win.blocked_windows)
@@ -1155,6 +1188,7 @@ def analyze_plan(
         unknown_tables=list(direct.unknown_tables),
         spillable=spillable,
         spill_partitions=spill_partitions,
+        feedback_overrides=len(direct.feedback_applied),
     )
 
 
@@ -1232,6 +1266,34 @@ def budget_plan(plan: P.PlanNode, session) -> Optional[PlanBudget]:
         session.last_plan_budget = None
         return None
     sf = session.conf.get("engine.plan_budget_sf")
+    # cardinality feedback (analysis/feedback.py): compute this plan's
+    # per-node store keys once, consume recorded actuals as estimate
+    # overrides in mode `on`, and (below) annotate node_fp/est_rows onto
+    # the nodes so the executor can record what actually happened. Store
+    # absent or mode off: fb_fps stays None and NOTHING changes
+    from . import feedback as _feedback
+
+    fb_store = getattr(session, "feedback_store", None)
+    fb_mode = "off"
+    fb_fps = None
+    fb_overrides = None
+    if fb_store is not None:
+        fb_mode = _feedback.resolve_feedback_mode(session.conf)
+    if fb_mode != "off":
+        try:
+            fb_fps = _feedback.plan_node_fps(plan, session)
+        except Exception:
+            if os.environ.get("NDS_PLAN_BUDGET_STRICT"):
+                raise
+            fb_fps = None
+        if fb_fps and fb_mode == "on":
+            fb_overrides = {}
+            with session.cache_lock:
+                for nid, fp in fb_fps.items():
+                    rec = fb_store.lookup(fp)
+                    rows = (rec or {}).get("rows") or {}
+                    if rows.get("max") is not None:
+                        fb_overrides[nid] = int(rows["max"])
     try:
         pb = analyze_plan(
             plan,
@@ -1240,6 +1302,7 @@ def budget_plan(plan: P.PlanNode, session) -> Optional[PlanBudget]:
             budget_bytes=resolve_budget_bytes(session.conf),
             reject_bytes=resolve_reject_bytes(session.conf),
             mesh_devices=session_mesh_devices(session),
+            feedback=fb_overrides,
         )
     except Exception as exc:
         if os.environ.get("NDS_PLAN_BUDGET_STRICT"):
@@ -1248,6 +1311,31 @@ def budget_plan(plan: P.PlanNode, session) -> Optional[PlanBudget]:
         session.notify_failure(f"plan budgeter failed: {str(exc)[:200]}")
         return None
     emit_budget_event(getattr(session, "tracer", None), pb)
+    if fb_fps:
+        # annotate estimate accounting onto the plan (the same dynamic-
+        # annotation family as budget_window_rows: deliberately NOT
+        # dataclass fields, so structural fingerprints and the plan cache
+        # stay feedback-agnostic). The executor reads these to emit
+        # op_span est-vs-actual fields and to record into the store
+        for est in pb.nodes:
+            fp = fb_fps.get(id(est.node))
+            if fp is None:
+                continue
+            est.node.node_fp = fp
+            est.node.est_rows = est.rows
+            est.node.est_live_bytes = est.live_bytes
+        tracer = getattr(session, "tracer", None)
+        if tracer is not None:
+            tracer.emit(
+                "plan_feedback",
+                op="consume" if fb_mode == "on" else "annotate",
+                result="applied" if pb.feedback_overrides else "static",
+                mode=fb_mode,
+                lookups=len(fb_fps) if fb_mode == "on" else 0,
+                hits=len(fb_overrides or {}),
+                overrides=pb.feedback_overrides,
+                verdict=pb.verdict,
+            )
     # `warn` is observe-only: record + trace + arm the ladder, but never
     # change execution (no window annotation, no rejection) — the mode
     # the README points scale-out runs at precisely to escape enforcement
@@ -1282,6 +1370,13 @@ def budget_plan(plan: P.PlanNode, session) -> Optional[PlanBudget]:
         # seam still retries through the pool (report._next_rung)
         "spillable": pb.spillable,
         "spill_partitions": pb.spill_partitions,
+        # estimate-vs-actual accounting: the feedback mode this statement
+        # planned under, how many store hits were consulted and how many
+        # static estimates a recorded actual replaced (serve's /statusz
+        # and `profile --accuracy` read the downstream surfaces)
+        "feedback_mode": fb_mode,
+        "feedback_hits": len(fb_overrides or {}),
+        "feedback_overrides": pb.feedback_overrides,
     }
     if annotate:
         _annotate_blocked_windows(plan, pb.window_rows)

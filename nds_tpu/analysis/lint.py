@@ -676,17 +676,18 @@ def _r_undocumented_conf_knob(tree, relpath):
 #: the same discipline so a future refactor cannot silently regress them.
 _GUARDED_CACHES = (
     "exec_cache", "join_order_cache", "pallas_promotions", "plan_cache",
-    "aot_cache", "promotion_store",
+    "aot_cache", "promotion_store", "feedback_store",
 )
 
 #: attribute calls that mutate a cache object (ExecutableCache.lookup
 #: builds + inserts; AotCache.store/vacuum write + unlink entries;
-#: PromotionStore.record merges a verdict; OrderedDict/dict mutators).
-#: Plain `.get`/`.load` reads are not flagged — the LRU caches' own get()
-#: sites are lock-wrapped anyway.
+#: PromotionStore.record merges a verdict; FeedbackStore.lookup caches
+#: misses, record/record_skew buffer deltas, flush commits them;
+#: OrderedDict/dict mutators). Plain `.get`/`.load` reads are not
+#: flagged — the LRU caches' own get() sites are lock-wrapped anyway.
 _CACHE_MUTATORS = (
     "clear", "put", "pop", "popitem", "update", "setdefault", "lookup",
-    "store", "vacuum", "record",
+    "store", "vacuum", "record", "record_skew", "flush",
 )
 
 

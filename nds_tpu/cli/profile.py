@@ -182,6 +182,18 @@ def _render_profile(prof, top: int, per_query: bool):
               f"{t.get('aot_stores', 0)} stored, "
               f"{t.get('aot_evictions', 0)} evicted, "
               f"{t.get('aot_quarantined', 0)} quarantined")
+    # plan-feedback evidence (plan_feedback events); .get() because
+    # compacted artifacts from pre-feedback runs lack the block
+    fb = prof.get("feedback") or {}
+    if fb.get("records") or fb.get("lookups"):
+        rate = R.feedback_hit_rate(prof)
+        rate_s = "-" if rate is None else f"{rate:.1%}"
+        mean = R.feedback_err_mean(prof)
+        mean_s = "-" if mean is None else f"{mean:.3f}"
+        print(f"== plan feedback: {fb.get('records', 0)} actual(s) "
+              f"recorded; {fb.get('hits', 0)}/{fb.get('lookups', 0)} "
+              f"lookup(s) hit (rate {rate_s}); {fb.get('overrides', 0)} "
+              f"estimate(s) overridden; mean |log(est/actual)| {mean_s}")
     kernels = sorted(
         prof.get("kernel_totals", {}).items(),
         key=lambda kv: -kv[1]["dur_ms"],
@@ -195,6 +207,81 @@ def _render_profile(prof, top: int, per_query: bool):
             avg = k["dur_ms"] / k["count"] if k["count"] else 0.0
             print(f"   {name:<28}{k['count']:>6}{k['dur_ms']:>12,.1f}"
                   f"{avg:>10,.3f}{k['n_rows']:>14,}")
+
+
+def _accuracy_report(events, top: int) -> dict:
+    """Budgeter est-vs-actual error distributions per operator class, from
+    raw op_spans annotated by the plan-feedback loop (`est_rows` at budget
+    time, `actual_rows` at execution). Raw events only, like
+    --critical-path: compaction folds the spans away (the mergeable
+    summary keeps only per-class mean/max)."""
+    import math
+
+    per_class = {}
+    worst = []
+    for ev in events:
+        if ev.get("kind") != "op_span" or ev.get("est_rows") is None:
+            continue
+        actual = ev.get("actual_rows")
+        if actual is None:
+            actual = ev.get("rows")
+        if actual is None:
+            continue
+        err = abs(
+            math.log(max(int(ev["est_rows"]), 1))
+            - math.log(max(int(actual), 1))
+        )
+        per_class.setdefault(ev.get("node") or "?", []).append(err)
+        worst.append({
+            "query": ev.get("query"),
+            "node": ev.get("node"),
+            "est_rows": int(ev["est_rows"]),
+            "actual_rows": int(actual),
+            "abs_log_err": round(err, 4),
+        })
+    classes = {}
+    for node, errs in per_class.items():
+        errs.sort()
+        n = len(errs)
+        classes[node] = {
+            "n": n,
+            "median": round(errs[n // 2], 4),
+            "p90": round(errs[min(n - 1, (n * 9) // 10)], 4),
+            "max": round(errs[-1], 4),
+        }
+    worst.sort(key=lambda s: -s["abs_log_err"])
+    all_errs = sorted(e for errs in per_class.values() for e in errs)
+    return {
+        "samples": len(all_errs),
+        "median": (
+            round(all_errs[len(all_errs) // 2], 4) if all_errs else None
+        ),
+        "max": round(all_errs[-1], 4) if all_errs else None,
+        "by_class": classes,
+        "worst": worst[:top],
+    }
+
+
+def _render_accuracy(acc):
+    if not acc["samples"]:
+        print("== accuracy: no annotated op_spans (plan feedback off, or "
+              "an untraced run)")
+        return
+    print(f"== budgeter accuracy: median |log(est/actual)| "
+          f"{acc['median']:.3f}, max {acc['max']:.3f}, over "
+          f"{acc['samples']} annotated span(s)")
+    print(f"   {'operator':<18}{'n':>6}{'median':>10}{'p90':>10}{'max':>10}")
+    for node, c in sorted(
+        acc["by_class"].items(), key=lambda kv: -kv[1]["median"]
+    ):
+        print(f"   {node:<18}{c['n']:>6}{c['median']:>10.3f}"
+              f"{c['p90']:>10.3f}{c['max']:>10.3f}")
+    if acc["worst"]:
+        print(f"\n== worst {len(acc['worst'])} misestimate(s)")
+        for s in acc["worst"]:
+            print(f"   {s['query'] or '?'}/{s['node']}: est "
+                  f"{s['est_rows']:,} vs actual {s['actual_rows']:,} "
+                  f"(|log err| {s['abs_log_err']:.3f})")
 
 
 def _load_sqlite_shared(path):
@@ -228,6 +315,79 @@ def _load_sqlite_shared(path):
                 except ValueError:
                     pass
     return best
+
+
+def _load_bench_accuracy(path):
+    """The budgeter-accuracy fields (`budget_err_median`,
+    `feedback_hit_rate`) out of a bench artifact: the bench OUT line /
+    metrics report, or a driver capture whose `tail` holds it. Returns
+    the dict (values may be None) or None when the artifact carries
+    neither key — pre-feedback rounds compare as absent, not as zero."""
+    import re
+
+    try:
+        with open(path) as fh:
+            text = fh.read()
+    except OSError:
+        return None
+    best = None
+    for line in text.splitlines():
+        line = line.strip()
+        obj = None
+        if line.startswith("{"):
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                obj = None
+        if isinstance(obj, dict) and isinstance(obj.get("tail"), str):
+            line, obj = obj["tail"], None  # scan the captured tail below
+        if isinstance(obj, dict):
+            if "budget_err_median" in obj or "feedback_hit_rate" in obj:
+                best = {
+                    "budget_err_median": obj.get("budget_err_median"),
+                    "feedback_hit_rate": obj.get("feedback_hit_rate"),
+                }
+            continue
+        # metrics.csv rows ("key,value"), printed dict reprs, captured
+        # tails — take the LAST occurrence, like the sqlite loader
+        for key in ("budget_err_median", "feedback_hit_rate"):
+            m = None
+            for m in re.finditer(
+                rf"['\"]?{key}['\"]?\s*[:,]\s*([0-9.]+|None|null)", line
+            ):
+                pass
+            if m is not None:
+                best = best if best is not None else {}
+                v = m.group(1)
+                best[key] = None if v in ("None", "null") else float(v)
+    return best
+
+
+def _compare_bench_accuracy(old_path, new_path):
+    """Budgeter-accuracy headline comparison record (ISSUE 18: budgeter
+    error is a published, shrinking number). Fail-soft like the other
+    bench headlines: artifacts without the fields yield no record at
+    all. Regression: the median |log(est/actual)| grew more than 25%
+    AND by at least 0.1 (below that is sampling noise)."""
+    old = _load_bench_accuracy(old_path) or {}
+    new = _load_bench_accuracy(new_path)
+    if new is None and not old:
+        return []
+    rec = {
+        "level": "bench", "query": "budget_accuracy",
+        "old_err": old.get("budget_err_median"),
+        "new_err": (new or {}).get("budget_err_median"),
+        "old_hit_rate": old.get("feedback_hit_rate"),
+        "new_hit_rate": (new or {}).get("feedback_hit_rate"),
+        "change": "headline",
+    }
+    e_old, e_new = rec["old_err"], rec["new_err"]
+    if (
+        e_old is not None and e_new is not None
+        and e_new > e_old * 1.25 and e_new - e_old >= 0.1
+    ):
+        rec["change"] = "regression"
+    return [rec]
 
 
 def _compare_sqlite_shared(old_path, new_path):
@@ -306,6 +466,17 @@ def _compare_multichip(old_path, new_path):
 
 
 def _print_bench_rec(r):
+    if r.get("query") == "budget_accuracy":
+        def fmt(v):
+            return "-" if v is None else f"{v:.3f}"
+
+        hr = r.get("new_hit_rate")
+        hr_s = "-" if hr is None else f"{hr:.1%}"
+        flag = "  ** REGRESSED" if r["change"] == "regression" else ""
+        print(f"== budgeter accuracy: median |log(est/actual)| "
+              f"{fmt(r.get('old_err'))} -> {fmt(r.get('new_err'))} "
+              f"(feedback hit rate {hr_s}){flag}")
+        return
     if r.get("query") == "multichip":
         old_s = "-" if r.get("old_ratio") is None else f"{r['old_ratio']:.3f}"
         new_s = "-" if r.get("new_ratio") is None else f"{r['new_ratio']:.3f}"
@@ -434,6 +605,12 @@ def main(argv=None):
                         help="attribute per-query wall time to named "
                         "causes (and name the mesh straggler device) "
                         "instead of the operator breakdown")
+    parser.add_argument("--accuracy", action="store_true",
+                        help="report budgeter est-vs-actual error "
+                        "distributions per operator class with the worst "
+                        "misestimates, from raw op_spans annotated by the "
+                        "plan-feedback loop, instead of the operator "
+                        "breakdown")
     parser.add_argument("--min_attributed", type=float, metavar="FRAC",
                         help="with --critical-path: exit 1 when any "
                         "query's attributed wall share is below FRAC "
@@ -460,6 +637,30 @@ def main(argv=None):
             regs = R.compare_profiles(
                 old_prof, new_prof, ratio=args.ratio, min_ms=args.min_ms
             )
+            # budgeter-accuracy delta rides every A/B compare: mean
+            # |log(est/actual)| from the mergeable feedback summaries
+            # (works on compacted dirs; --accuracy needs raw spans)
+            e_old = R.feedback_err_mean(old_prof)
+            e_new = R.feedback_err_mean(new_prof)
+            if e_old is not None or e_new is not None:
+                rec = {
+                    "level": "bench", "query": "budget_accuracy",
+                    "old_err": (
+                        None if e_old is None else round(e_old, 4)
+                    ),
+                    "new_err": (
+                        None if e_new is None else round(e_new, 4)
+                    ),
+                    "old_hit_rate": R.feedback_hit_rate(old_prof),
+                    "new_hit_rate": R.feedback_hit_rate(new_prof),
+                    "change": "headline",
+                }
+                if (
+                    e_old is not None and e_new is not None
+                    and e_new > e_old * 1.25 and e_new - e_old >= 0.1
+                ):
+                    rec["change"] = "regression"
+                regs.append(rec)
         if args.bench:
             # artifact-type detection: a MULTICHIP round carries n_devices
             # (driver wrapper or mesh-gate metrics block); everything else
@@ -475,6 +676,9 @@ def main(argv=None):
                 regs.extend(_compare_multichip(*args.bench))
             else:
                 regs.extend(_compare_sqlite_shared(*args.bench))
+                # accuracy headline beside the sqlite_shared ratio (bench
+                # round arbitration: budgeter error must shrink)
+                regs.extend(_compare_bench_accuracy(*args.bench))
         if args.as_json:
             print(json.dumps({"regressions": regs}, indent=2))
         else:
@@ -510,7 +714,7 @@ def main(argv=None):
         sys.exit(2)
     if not args.paths:
         return  # bundle-only invocation
-    if args.critical_path:
+    if args.critical_path or args.accuracy:
         # raw events only: compaction artifacts hold pre-aggregated
         # profiles, not the spans the reconstruction needs
         try:
@@ -524,6 +728,13 @@ def main(argv=None):
                 for p in problems[:20]:
                     print(f"profile: schema: {p}", file=sys.stderr)
                 sys.exit(2)
+        if args.accuracy:
+            acc = _accuracy_report(events, args.top)
+            if args.as_json:
+                print(json.dumps(acc, indent=2))
+            else:
+                _render_accuracy(acc)
+            return
         cp = CP.critical_path(events)
         if args.as_json:
             print(json.dumps(cp, indent=2))

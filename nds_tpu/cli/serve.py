@@ -62,6 +62,14 @@ def build_service(args):
     if aot_dir:
         conf["engine.aot_cache_dir"] = aot_dir
         os.environ["NDS_AOT_CACHE_DIR"] = aot_dir
+    # fleet cardinality feedback: same wiring shape. A shared
+    # --aot_cache_dir already shares feedback implicitly (the store
+    # defaults to <aot dir>/feedback); this flag points replicas at a
+    # standalone store when the AOT dir is per-host or disabled.
+    fb_dir = getattr(args, "feedback_dir", None)
+    if fb_dir:
+        conf["engine.feedback_dir"] = fb_dir
+        os.environ["NDS_FEEDBACK_DIR"] = fb_dir
     use_decimal = not args.floats
     session = Session(use_decimal=use_decimal, conf=conf)
     # DML runs on its own session (own caches, own last_plan_budget) so
@@ -144,6 +152,13 @@ def main(argv=None):
         help="shared AOT executable cache dir (engine.aot_cache_dir): "
         "point every fleet replica at the dir `cache warm --fleet` "
         "filled so N replicas pay one compile, not N",
+    )
+    parser.add_argument(
+        "--feedback_dir",
+        help="shared cardinality feedback store dir "
+        "(engine.feedback_dir): replicas record and consume learned "
+        "per-node actuals fleet-wide; defaults to <aot_cache_dir>/"
+        "feedback when an AOT dir is set",
     )
     args = parser.parse_args(argv)
     service, server = build_service(args)

@@ -13,6 +13,7 @@ rapids plugin (reference: nds/nds_power.py:125-135 spark.sql -> collect).
 from __future__ import annotations
 
 import itertools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -245,6 +246,46 @@ class Executor:
             fp = self._fp_cache[key] = P.fingerprint(node)
         return fp
 
+    # pipeline breakers whose actual row count is worth a forced host
+    # sync when it isn't already there: a handful per plan, and their
+    # consumers are about to sync anyway. Row-preserving nodes record
+    # only opportunistically (count already on host) — feedback must not
+    # add a device round-trip per traced node.
+    _FEEDBACK_SYNC = (P.Join, P.MultiJoin, P.Aggregate, P.Distinct,
+                      P.SetOp, P.Window, P.Sort)
+
+    def _record_feedback(self, node, out):
+        """Record this node's measured cardinality into the session
+        FeedbackStore (buffered; Result.table flushes per statement).
+        Only called for nodes budget_plan annotated with `node_fp` —
+        i.e. engine.plan_feedback is record/on and a store exists."""
+        session = getattr(self.catalog, "session", None)
+        store = getattr(session, "feedback_store", None)
+        if store is None:
+            return
+        rows = out.nrows_known
+        if rows is None and (
+            isinstance(node, self._FEEDBACK_SYNC)
+            or (isinstance(node, P.Pipeline) and node.agg is not None)
+        ):
+            rows = out.nrows
+        if rows is None:
+            return
+        est_rows = getattr(node, "est_rows", None)
+        with session.cache_lock:
+            err = store.record(
+                node.node_fp, rows=rows, nbytes=table_device_bytes(out),
+                est_rows=est_rows,
+            )
+        if self.tracer is not None:
+            ev = dict(op="record", result="ok",
+                      node=type(node).__name__, actual_rows=int(rows))
+            if est_rows is not None:
+                ev["est_rows"] = int(est_rows)
+            if err is not None:
+                ev["abs_log_err"] = round(err, 4)
+            self.tracer.emit("plan_feedback", **ev)
+
     # ------------------------------------------------------------------
     def execute(self, node: P.PlanNode) -> Table:
         if not self._fault_checked:
@@ -294,9 +335,14 @@ class Executor:
             finally:
                 self._span_depth = depth
             dur_ms = (_perf() - t0) * 1000.0
+            # estimate-vs-actual accounting BEFORE the span emit: a
+            # pipeline-breaker record may force the queued count onto the
+            # host, and the span's actual_rows should see it
+            fp = getattr(node, "node_fp", None)
+            if fp is not None:
+                self._record_feedback(node, out)
             self._span_seq += 1
-            tracer.emit(
-                "op_span",
+            span = dict(
                 exec_id=self._exec_id,
                 seq=self._span_seq,
                 depth=depth,
@@ -308,8 +354,24 @@ class Executor:
                 rows=out.nrows_known,
                 est_bytes=table_device_bytes(out),
             )
+            if fp is not None:
+                # budgeter accounting (analysis/feedback.py annotations):
+                # est_rows/est_live_bytes are the STATIC model's numbers,
+                # actual_* what this execution measured. `est_bytes`
+                # above keeps its historical meaning (realized device
+                # bytes — the calibration harness pins it)
+                span["node_fp"] = fp
+                span["est_rows"] = getattr(node, "est_rows", None)
+                span["est_live_bytes"] = getattr(
+                    node, "est_live_bytes", None
+                )
+                span["actual_rows"] = out.nrows_known
+                span["actual_bytes"] = table_device_bytes(out)
+            tracer.emit("op_span", **span)
         else:
             out = m(node)
+            if getattr(node, "node_fp", None) is not None:
+                self._record_feedback(node, out)
         self._cte_cache[key] = out
         if cache is not None:
             with self.catalog.session.cache_lock:
@@ -707,7 +769,7 @@ class Executor:
         return int(n_dev) * int(per_dev)
 
     def _emit_exchange(self, op, n_dev, bytes_moved, counts, retries,
-                       dur_ms=None):
+                       dur_ms=None, node_fp=None):
         """One `exchange` trace event per executed collective exchange:
         bytes moved over the interconnect (padded-capacity measure, both
         all_to_all passes), partition (device) count, the received-row
@@ -716,14 +778,27 @@ class Executor:
         of the whole exchange step (`dur_ms`, retries included — the
         critical-path profiler's exchange-wait cause), and the per-device
         received-row counts (`per_device` — what names the straggler
-        device)."""
-        if self.tracer is None:
+        device).
+
+        With a `node_fp` (plan_feedback record/on) the measured skew also
+        records into the session FeedbackStore — the seed the NEXT
+        execution's capacity guess consumes instead of the retry ladder —
+        even when the session is untraced."""
+        if self.tracer is None and node_fp is None:
             return
         c = np.asarray(counts, dtype=np.float64)
         total = float(c.sum())
         skew = 1.0
         if total > 0 and c.size:
             skew = float(c.max() / (total / c.size))
+        if node_fp is not None:
+            session = getattr(self.catalog, "session", None)
+            store = getattr(session, "feedback_store", None)
+            if store is not None:
+                with session.cache_lock:
+                    store.record_skew(node_fp, skew, retries=int(retries))
+        if self.tracer is None:
+            return
         self.tracer.emit(
             "exchange", op=op, partitions=int(n_dev),
             bytes_moved=int(bytes_moved), skew=round(skew, 3),
@@ -732,6 +807,30 @@ class Executor:
             **({"dur_ms": round(float(dur_ms), 3)}
                if dur_ms is not None else {}),
         )
+
+    def _feedback_skew_seed(self, node_fp, n_dev) -> int:
+        """Integer capacity multiplier from a recorded exchange skew for
+        this plan node (plan_feedback=on), clamped to the mesh width (a
+        single destination can never need more than n_dev x the balanced
+        per-bucket share). 1 = no recorded skew worth seeding."""
+        if node_fp is None:
+            return 1
+        session = getattr(self.catalog, "session", None)
+        store = getattr(session, "feedback_store", None)
+        if store is None:
+            return 1
+        # mesh-only cold path (see _try_exchange_join)
+        # nds-lint: disable=local-import
+        from ..analysis.feedback import resolve_feedback_mode
+
+        if resolve_feedback_mode(session.conf) != "on":
+            return 1
+        with session.cache_lock:
+            rec = store.lookup(node_fp)
+        skew = float(((rec or {}).get("skew") or {}).get("max") or 0.0)
+        if skew <= 1.25:
+            return 1
+        return int(min(math.ceil(skew), n_dev))
 
     def _try_dist_sort(self, child: Table, keys):
         if not keys:
@@ -897,6 +996,7 @@ class Executor:
             left, right, node.kind, node.left_keys, node.right_keys,
             node.residual, node.mark_name,
             spill_parts=self._spill_parts_for(node),
+            node_fp=getattr(node, "node_fp", None),
         )
 
     def _exec_multijoin(self, node: P.MultiJoin) -> Table:
@@ -922,10 +1022,11 @@ class Executor:
         return self._multijoin_over_tables(
             tables, node.edges, trace=trace,
             spill_parts=self._spill_parts_for(node),
+            node_fp=getattr(node, "node_fp", None),
         )
 
     def _multijoin_over_tables(self, tables, edges, trace=None,
-                               spill_parts=0) -> Table:
+                               spill_parts=0, node_fp=None) -> Table:
         """Greedy N-way inner join over already-executed relation tables
         (shared by _exec_multijoin and the blocked union-aggregation path,
         which re-joins each union window against the other relations).
@@ -949,7 +1050,7 @@ class Executor:
         current = {i: tables[i] for i in range(n)}
 
         return self._multijoin_greedy(current, edges, merged, group, n, trace,
-                                      spill_parts)
+                                      spill_parts, node_fp=node_fp)
 
     def _execute_relations_batched(self, relations):
         """Execute a MultiJoin's relations and materialize their live
@@ -967,7 +1068,7 @@ class Executor:
         return tables
 
     def _multijoin_greedy(self, current, edges, merged, group, n, trace=None,
-                          spill_parts=0):
+                          spill_parts=0, node_fp=None):
         # greedy: repeatedly take the connecting edge whose joined inputs are
         # smallest (sum of live rows), execute that join. When `trace`
         # carries recorded steps, replay them instead (identical relation
@@ -1023,7 +1124,7 @@ class Executor:
             edges = rest
             joined = self._join(
                 current[gi], current[gj], "inner", lkeys, rkeys, None,
-                spill_parts=spill_parts,
+                spill_parts=spill_parts, node_fp=node_fp,
             )
             merged[gj] = gi
             current[gi] = joined
@@ -1048,7 +1149,7 @@ class Executor:
         return t
 
     def _join(self, left, right, kind, left_keys, right_keys, residual,
-              mark_name=None, spill_parts=0):
+              mark_name=None, spill_parts=0, node_fp=None):
         if kind == "cross":
             return self._cross_join(left, right)
         left = self._pack_sparse(left)
@@ -1056,7 +1157,8 @@ class Executor:
         if kind == "right":
             # swap before any matching so the residual is preserved
             return self._join(right, left, "left", right_keys, left_keys,
-                              residual, spill_parts=spill_parts)
+                              residual, spill_parts=spill_parts,
+                              node_fp=node_fp)
         lev = self._evaluator(left)
         rev = self._evaluator(right)
         lcols = [lev.eval(e) for e in left_keys]
@@ -1080,7 +1182,7 @@ class Executor:
             return fast
         fast = self._try_exchange_join(
             left, right, kind, left_keys, right_keys,
-            lk, lv, rk, rv, llive, rlive, residual
+            lk, lv, rk, rv, llive, rlive, residual, node_fp=node_fp,
         )
         if fast is not None:
             return fast
@@ -1391,7 +1493,7 @@ class Executor:
 
     def _try_exchange_join(
         self, left, right, kind, left_keys, right_keys,
-        lk, lv, rk, rv, llive, rlive, residual,
+        lk, lv, rk, rv, llive, rlive, residual, node_fp=None,
     ):
         mesh = getattr(self.catalog, "session", None)
         mesh = getattr(mesh, "mesh", None)
@@ -1451,6 +1553,16 @@ class Executor:
         pair_cap = bucket_cap(
             max(1, 2 * max(left.nrows, right.nrows) // n_dev)
         )
+        # feedback skew seeding (analysis/feedback.py, plan_feedback=on):
+        # a recorded received-row skew for THIS plan node scales the
+        # balanced capacity guess up front, so a known-hot key fits on
+        # attempt 1 instead of rediscovering the imbalance through the
+        # overflow-retry doubling ladder below
+        seed = self._feedback_skew_seed(node_fp, n_dev)
+        if seed > 1:
+            cap_l = bucket_cap(cap_l * seed)
+            cap_r = bucket_cap(cap_r * seed)
+            pair_cap = bucket_cap(pair_cap * seed)
         retries = 0
         rest = None
         used_l, used_r = cap_l, cap_r  # caps the LAST attempt shipped with
@@ -1490,6 +1602,7 @@ class Executor:
                     self._exchange_bytes(n_dev, used_l, used_r,
                                          lh, lk, l_ship, rh, rk, r_ship),
                     rest[-2], retries, dur_ms=(_perf() - ex_t0) * 1000.0,
+                    node_fp=node_fp,
                 )
             if str(session.conf.get("engine.spill", "auto")).lower() == "off":
                 return None  # out-of-core disabled: legacy sort-join fallback
@@ -1511,6 +1624,7 @@ class Executor:
             self._exchange_bytes(n_dev, used_l, used_r,
                                  lh, lk, l_ship, rh, rk, r_ship),
             rest[-2], retries, dur_ms=(_perf() - ex_t0) * 1000.0,
+            node_fp=node_fp,
         )
         l_out = rest[:n_lc]
         r_out = rest[n_lc:n_lc + n_rc]

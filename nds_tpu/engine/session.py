@@ -668,6 +668,14 @@ class Result:
         if self._table is None:
             self.executor = self.session._executor(tracer=tracer)
             self._table = self.executor.execute(self.plan)
+            # commit this statement's buffered cardinality records (one
+            # merge+write per touched key, not per executed node) so a
+            # second run — or another process sharing the store dir —
+            # plans from what this one measured
+            store = getattr(self.session, "feedback_store", None)
+            if store is not None:
+                with self.session.cache_lock:
+                    store.flush()
         return self._table
 
     def collect(self, tracer=None) -> pa.Table:
@@ -795,6 +803,26 @@ class Session:
                         resolve_aot_cache_bytes(self.conf, _aot_dir),
                         tracer=lambda: self.tracer,
                     )
+        # estimate-vs-actual cardinality feedback (analysis/feedback.py):
+        # persistent (node_fp, scale)-keyed actuals shared across
+        # processes and serve replicas. Rides the AOT cache dir by
+        # default (<dir>/feedback) so the --aot_cache_dir fleet wiring
+        # shares learned cardinalities exactly like compiled
+        # executables; works under a mesh (JSON stats, no executables).
+        # Disable with NDS_FEEDBACK_DIR=0 / engine.plan_feedback=off.
+        from ..analysis.feedback import (
+            FeedbackStore,
+            resolve_feedback_bytes,
+            resolve_feedback_dir,
+        )
+
+        self.feedback_store = None
+        _fb_dir = resolve_feedback_dir(self.conf)
+        if _fb_dir:
+            _aot_sweep(_fb_dir)  # same .tmp-<pid> naming scheme
+            self.feedback_store = FeedbackStore(
+                _fb_dir, resolve_feedback_bytes(self.conf, _fb_dir)
+            )
         # stats of the most recent blocked union-aggregation any executor
         # of this session ran (bench.py's OOM-bail heuristic reads it)
         self.last_blocked_union = None

@@ -675,6 +675,34 @@ def _run_full_bench_phases(params, resume, num_streams, tracer, trace_dir):
     }
     if mul_error:
         metrics["maintenance_under_load_error"] = mul_error
+    # budgeter-accuracy headline beside the composite metric: the bench
+    # trace dir aggregates every phase subprocess's plan_feedback events.
+    # FAIL-SOFT — a torn trace file must not cost a finished benchmark.
+    if trace_dir:
+        errs = []
+
+        def _collect(events):
+            errs.extend(
+                float(e["abs_log_err"]) for e in events
+                if e.get("kind") == "plan_feedback"
+                and e.get("abs_log_err") is not None
+            )
+
+        try:
+            prof = obs_reader.load_profile(
+                [trace_dir], strict=False, events_hook=_collect
+            )
+            rate = obs_reader.feedback_hit_rate(prof)
+            metrics["feedback_hit_rate"] = (
+                None if rate is None else round(rate, 4)
+            )
+            errs.sort()
+            metrics["budget_err_median"] = (
+                round(errs[len(errs) // 2], 4) if errs else None
+            )
+        except Exception:
+            metrics["feedback_hit_rate"] = None
+            metrics["budget_err_median"] = None
     print(metrics)
     write_metrics_report(params["metrics_report_path"], metrics)
     return metrics

@@ -94,6 +94,9 @@ METRIC_KINDS = {
     "nds_child_stream_total": "child_stream",
     "nds_plan_verify_total": "plan_verify",
     "nds_plan_budget_total": "plan_budget",
+    "nds_plan_feedback_total": "plan_feedback",
+    "nds_plan_feedback_overrides_total": "plan_feedback",
+    "nds_plan_feedback_err_median": "plan_feedback",  # gauge (|log| median)
     "nds_mem_watermark_total": "mem_watermark",
     "nds_heartbeat_total": "heartbeat",
     "nds_heartbeat_rss_bytes": "heartbeat",         # gauge (latest)
@@ -702,6 +705,72 @@ class MetricsSink:
             "nds_plan_budget_total", verdict=str(ev.get("verdict"))
         )
 
+    #: |log(est/actual)| bucket edges for the budgeter-accuracy median.
+    #: Bounded on purpose: a long-lived service records one sample per
+    #: executed feedback node forever, and an exact sample list would grow
+    #: without limit. 0.69 ~= a 2x miss, 2.3 ~= a 10x miss.
+    FEEDBACK_ERR_EDGES = (0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 4.0)
+
+    def _feedback_err_median_locked(self, fb):
+        """Median |log(est/actual)| from the bounded bucket tallies —
+        reported as the upper edge of the bucket the median sample falls
+        in (the overflow bucket reports 2x the last edge). Caller holds
+        _slock."""
+        n = fb.get("err_n") or 0
+        if not n:
+            return None
+        half = (n + 1) // 2
+        acc = 0
+        for i, c in enumerate(fb["err_buckets"]):
+            acc += c
+            if acc >= half:
+                edges = self.FEEDBACK_ERR_EDGES
+                return edges[i] if i < len(edges) else edges[-1] * 2
+        return None
+
+    def _h_plan_feedback(self, ev):
+        op = str(ev.get("op"))
+        self.registry.inc(
+            "nds_plan_feedback_total", op=op, result=str(ev.get("result"))
+        )
+        if ev.get("overrides"):
+            self.registry.inc(
+                "nds_plan_feedback_overrides_total", int(ev["overrides"])
+            )
+        med = None
+        with self._slock:
+            fb = self._status.setdefault("feedback", {
+                "lookups": 0, "hits": 0, "records": 0, "overrides": 0,
+                "err_n": 0,
+                "err_buckets": [0] * (len(self.FEEDBACK_ERR_EDGES) + 1),
+                "mode": None, "last_verdict": None,
+            })
+            if op in ("consume", "annotate"):
+                # budget-time event: one per budgeted plan, carries the
+                # store's lookup/hit tallies for that plan
+                fb["lookups"] += int(ev.get("lookups") or 0)
+                fb["hits"] += int(ev.get("hits") or 0)
+                fb["overrides"] += int(ev.get("overrides") or 0)
+                if ev.get("mode") is not None:
+                    fb["mode"] = str(ev["mode"])
+                if ev.get("verdict") is not None:
+                    fb["last_verdict"] = str(ev["verdict"])
+            elif op == "record":
+                fb["records"] += 1
+                err = ev.get("abs_log_err")
+                if err is not None:
+                    e = float(err)
+                    fb["err_n"] += 1
+                    for i, hi in enumerate(self.FEEDBACK_ERR_EDGES):
+                        if e <= hi:
+                            fb["err_buckets"][i] += 1
+                            break
+                    else:
+                        fb["err_buckets"][-1] += 1
+                    med = self._feedback_err_median_locked(fb)
+        if med is not None:
+            self.registry.set_gauge("nds_plan_feedback_err_median", med)
+
     def _h_mem_watermark(self, ev):
         self.registry.inc("nds_mem_watermark_total")
 
@@ -890,6 +959,13 @@ class MetricsSink:
                     )
                     for k, v in fleet.items()
                 }
+            if "feedback" in st:
+                # deep-copy: err_buckets is a live list mutating under
+                # this lock after the snapshot escapes it
+                fb = dict(self._status["feedback"])
+                fb["err_buckets"] = list(fb["err_buckets"])
+                fb["err_median"] = self._feedback_err_median_locked(fb)
+                st["feedback"] = fb
             in_flight = []
             for rec in self._in_flight.values():
                 rec = dict(rec)
@@ -907,6 +983,29 @@ class MetricsSink:
             "plan_cache": self._hit_rate("nds_plan_cache_total", "result", "hit"),
             "catalog": self._hit_rate("nds_catalog_load_total", "cache", "hit"),
         }
+        fb = st.get("feedback")
+        if fb:
+            # budgeter accuracy: how wrong the static estimates are
+            # (median |log(est/actual)| over recorded nodes), what verdicts
+            # the budgeter handed out, and how often a lookup found a
+            # recorded actual to override with
+            lookups = fb.get("lookups") or 0
+            verdicts = {}
+            for labels, v in self.registry.counter_series(
+                    "nds_plan_budget_total").items():
+                for k, val in labels:
+                    if k == "verdict":
+                        verdicts[val] = verdicts.get(val, 0) + int(v)
+            st["budgeter_accuracy"] = {
+                "err_median": fb.get("err_median"),
+                "err_samples": fb.get("err_n") or 0,
+                "feedback_hit_rate": (
+                    round((fb.get("hits") or 0) / lookups, 4)
+                    if lookups else None
+                ),
+                "feedback_mode": fb.get("mode"),
+                "verdicts": verdicts,
+            }
         hb = st.get("heartbeat_ts_ms")
         # epoch-minus-epoch on purpose: heartbeat `ts` is the event's epoch
         # stamp (possibly from another thread's clock read) — there is no
@@ -963,6 +1062,7 @@ _HANDLERS = {
     "child_stream": MetricsSink._h_child_stream,
     "plan_verify": MetricsSink._h_plan_verify,
     "plan_budget": MetricsSink._h_plan_budget,
+    "plan_feedback": MetricsSink._h_plan_feedback,
     "mem_watermark": MetricsSink._h_mem_watermark,
     "heartbeat": MetricsSink._h_heartbeat,
     "serve_request": MetricsSink._h_serve_request,

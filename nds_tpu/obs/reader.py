@@ -346,6 +346,19 @@ def profile_events(events) -> dict:
         "max_peak_bytes": 0,
         "max_budget_bytes": 0,
     }
+    feedback = {
+        "lookups": 0,       # store probes at budget time (mode=on)
+        "hits": 0,          # probes that found a recorded actual
+        "overrides": 0,     # per-node estimates actually replaced
+        "records": 0,       # actuals recorded at execution time
+        "err_n": 0,         # records that carried an |log(est/actual)|
+        "err_sum": 0.0,
+        "err_max": 0.0,
+        # node class -> {n, err_sum, err_max}: the mergeable summary
+        # behind `profile --accuracy` (full distributions come from the
+        # raw op_spans, which compaction folds away)
+        "by_node": {},
+    }
     for ev in events:
         k = ev.get("kind")
         if k == "query_span":
@@ -447,6 +460,29 @@ def profile_events(events) -> dict:
             budget["max_budget_bytes"] = max(
                 budget["max_budget_bytes"], int(ev.get("budget_bytes") or 0)
             )
+        elif k == "plan_feedback":
+            op = ev.get("op")
+            if op in ("consume", "annotate"):
+                feedback["lookups"] += int(ev.get("lookups") or 0)
+                feedback["hits"] += int(ev.get("hits") or 0)
+                feedback["overrides"] += int(ev.get("overrides") or 0)
+            elif op == "record":
+                feedback["records"] += 1
+                err = ev.get("abs_log_err")
+                if err is not None:
+                    e = float(err)
+                    feedback["err_n"] += 1
+                    feedback["err_sum"] += e
+                    if e > feedback["err_max"]:
+                        feedback["err_max"] = e
+                    node = ev.get("node") or "<unknown>"
+                    rec = feedback["by_node"].setdefault(
+                        node, {"n": 0, "err_sum": 0.0, "err_max": 0.0}
+                    )
+                    rec["n"] += 1
+                    rec["err_sum"] += e
+                    if e > rec["err_max"]:
+                        rec["err_max"] = e
         elif k == "mem_watermark":
             tallies["mem_watermarks"] += 1
     return {
@@ -455,6 +491,7 @@ def profile_events(events) -> dict:
         "kernel_totals": kernel_totals,
         "tallies": tallies,
         "plan_budget": budget,
+        "feedback": feedback,
     }
 
 
@@ -467,6 +504,28 @@ def exec_cache_hit_rate(prof: dict):
     if probes == 0:
         return None
     return t["exec_cache_hits"] / probes
+
+
+def feedback_hit_rate(prof: dict):
+    """Feedback-store hit rate of a profiled run (budget-time lookups
+    that found a recorded actual), or None when the run did no lookups
+    (plan_feedback off/record — record mode never probes). The bench OUT
+    line and `profile --bench` headline read this."""
+    fb = prof.get("feedback") or {}
+    lookups = fb.get("lookups") or 0
+    if not lookups:
+        return None
+    return (fb.get("hits") or 0) / lookups
+
+
+def feedback_err_mean(prof: dict):
+    """Mean |log(est/actual)| over the run's recorded feedback samples,
+    or None when nothing carried an error (no estimates annotated)."""
+    fb = prof.get("feedback") or {}
+    n = fb.get("err_n") or 0
+    if not n:
+        return None
+    return float(fb.get("err_sum") or 0.0) / n
 
 
 def aot_disk_hit_rate(prof: dict):
@@ -514,7 +573,7 @@ def read_compact(path) -> dict:
     ):
         raise ValueError(f"{path}: not a profile-compaction artifact")
     for key in ("queries", "op_totals", "kernel_totals", "tallies",
-                "plan_budget"):
+                "plan_budget", "feedback"):
         v = prof.get(key)
         if v is None:
             continue
@@ -597,6 +656,30 @@ def merge_profiles(base: dict, extra: dict) -> dict:
         pb_dst["verdicts"][v] = pb_dst["verdicts"].get(v, 0) + n
     for key in ("max_peak_bytes", "max_budget_bytes"):
         pb_dst[key] = max(pb_dst.get(key, 0), int(pb_src.get(key) or 0))
+    fb_src = extra.get("feedback") or {}
+    fb_dst = base.setdefault("feedback", {
+        "lookups": 0, "hits": 0, "overrides": 0, "records": 0,
+        "err_n": 0, "err_sum": 0.0, "err_max": 0.0, "by_node": {},
+    })
+    for key in ("lookups", "hits", "overrides", "records", "err_n"):
+        fb_dst[key] = fb_dst.get(key, 0) + int(fb_src.get(key) or 0)
+    fb_dst["err_sum"] = (
+        fb_dst.get("err_sum", 0.0) + float(fb_src.get("err_sum") or 0.0)
+    )
+    fb_dst["err_max"] = max(
+        fb_dst.get("err_max", 0.0), float(fb_src.get("err_max") or 0.0)
+    )
+    for node, src in (fb_src.get("by_node") or {}).items():
+        dst = fb_dst.setdefault("by_node", {}).setdefault(
+            node, {"n": 0, "err_sum": 0.0, "err_max": 0.0}
+        )
+        dst["n"] = dst.get("n", 0) + int(src.get("n") or 0)
+        dst["err_sum"] = (
+            dst.get("err_sum", 0.0) + float(src.get("err_sum") or 0.0)
+        )
+        dst["err_max"] = max(
+            dst.get("err_max", 0.0), float(src.get("err_max") or 0.0)
+        )
     return base
 
 

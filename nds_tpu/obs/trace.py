@@ -199,6 +199,17 @@ EVENT_SCHEMA = {
     # reason is connect | midstream | shed | fault | upstream. Optional:
     # tenant, request_id, attempt, delay_ms
     "route_retry": ("replica", "reason"),
+    # estimate-vs-actual cardinality feedback (analysis/feedback.py):
+    # op "annotate"/"consume" (budget_plan's per-statement summary —
+    # result applied | static, with mode/lookups/hits/overrides/verdict)
+    # and "record" (one executed node's measured cardinality folded into
+    # the FeedbackStore — result ok, with node/actual_rows and, when the
+    # static estimate was annotated, est_rows + abs_log_err, the
+    # |log(est/actual)| error sample `profile --accuracy` distributes).
+    # op_span events on feedback-annotated nodes also carry node_fp /
+    # est_rows / est_live_bytes / actual_rows / actual_bytes as optional
+    # fields (est_bytes keeps its historical realized-bytes meaning)
+    "plan_feedback": ("op", "result"),
     # liveness beacon from the per-query memory-sampler thread
     # (obs/memwatch.py, armed by report.py while a traced query runs):
     # a hung query keeps heartbeating, so the hang is visible live on

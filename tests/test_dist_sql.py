@@ -352,6 +352,53 @@ def test_exchange_join_hot_key_skew_matches_oracle(monkeypatch):
                for e in ex)
 
 
+def test_exchange_join_skew_feedback_drops_retries_to_zero(
+    monkeypatch, tmp_path
+):
+    """Recorded hot-key skew seeds the NEXT session's exchange capacity
+    (analysis/feedback.py): run 1 (record mode) pays the overflow-retry
+    doubling and persists the measured skew; run 2 (on mode, same store
+    dir) pre-splits its capacity guess from the record and lands the
+    identical oracle-equal answer with ZERO retries — the rediscovery
+    cost is paid once per fleet, not once per session."""
+    from nds_tpu.obs.trace import Tracer
+
+    taken = _spy_exchange(monkeypatch)
+    rng = np.random.default_rng(31)
+    n = 8192
+    hot = rng.random(n) < 0.6  # the same hot-key shape as the probe above
+    k = np.where(hot, 13, rng.integers(0, 1024, n)) * 1_000_003
+    left = pa.table({"k": k, "lv": np.arange(n, dtype=np.int64)})
+    right = pa.table({
+        "k": np.arange(1024, dtype=np.int64) * 1_000_003,
+        "rv": np.arange(1024, dtype=np.int64),
+    })
+    q = ("select count(*) c, sum(lv) sl, sum(rv) sr from l, r "
+         "where l.k = r.k")
+
+    def run(mode):
+        oracle, dist = _exchange_pair(
+            conf={"engine.feedback_dir": str(tmp_path / "fb"),
+                  "engine.plan_feedback": mode},
+            tables={"l": left, "r": right},
+        )
+        tracer = Tracer(None)
+        dist.tracer = tracer
+        a = oracle.sql(q).to_pylist()
+        b = dist.sql(q).to_pylist()
+        assert a == b, mode
+        return ([e for e in tracer.events if e["kind"] == "exchange"],
+                dist.feedback_store)
+
+    ex1, store1 = run("record")
+    assert ex1 and any(e["retries"] > 0 for e in ex1), ex1
+    assert store1.stats["skew_records"] >= 1
+    ex2, _store2 = run("on")
+    assert ex2 and all(e["retries"] == 0 for e in ex2), ex2
+    assert any(e["skew"] > 2.0 for e in ex2)  # data still skewed; no retry
+    assert any(taken)
+
+
 def test_exchange_join_empty_partitions_match_oracle(monkeypatch):
     """Keys covering only 2 of 8 destinations: six devices receive ZERO
     rows and the join must still equal the oracle (the empty-partition

@@ -1,0 +1,268 @@
+"""Estimate-vs-actual cardinality feedback (analysis/feedback.py): the
+contract is "a learned cardinality can sharpen a verdict, never corrupt
+one" — the two-run gate proves budgeter error is a measured, SHRINKING
+number (run 1 records, run 2 consumes, a misestimated plan's verdict
+flips and the median |log(est/actual)| strictly drops), and the store
+units prove the persistence discipline (corruption quarantines as a
+miss, a foreign key is a clean miss, two processes share one dir, dead
+temps sweep, the LRU byte budget holds)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from nds_tpu.analysis import feedback as FB
+from nds_tpu.engine.session import Session
+
+FP_A = "a" * 40
+FP_B = "b" * 40
+
+
+def _store(tmp_path, budget=1 << 30):
+    return FB.FeedbackStore(str(tmp_path / "fb"), budget)
+
+
+def _misest_table(n=200_000, seed=5):
+    """A table whose `k < 10` selectivity the static model misestimates
+    by orders of magnitude: 50k distinct keys means the filter keeps
+    ~n/5000 rows while the conjunction floor models vastly more."""
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": rng.integers(0, 50_000, n).astype(np.int64),
+        "v": rng.random(n),
+    })
+
+
+def _gate_session(tmp_path, mode, table=None, budget_bytes=8 << 20):
+    s = Session(conf={
+        "engine.feedback_dir": str(tmp_path / "fb"),
+        "engine.plan_feedback": mode,
+        "engine.plan_budget": "warn",
+        "engine.plan_budget_bytes": budget_bytes,
+    })
+    s.register_arrow("t", table if table is not None else _misest_table())
+    return s
+
+
+GATE_Q = "select k, sum(v) s from t where k < 10 group by k order by k"
+
+
+# ---------------------------------------------------------------------------
+# the two-run gate: record, then consume; error strictly shrinks
+# ---------------------------------------------------------------------------
+
+
+def test_two_run_gate_verdict_flips_and_error_shrinks(tmp_path):
+    """Run 1 (record): the static model's misestimate forces a `spill`
+    verdict and records the actuals. Run 2 (on): the recorded actuals
+    override the estimates, the verdict flips to `direct`, the result is
+    identical, and the median |log(est/actual)| is STRICTLY smaller —
+    the ISSUE 18 acceptance assertion."""
+    s1 = _gate_session(tmp_path, "record")
+    out1 = s1.sql(GATE_Q).to_pylist()
+    pb1 = s1.last_plan_budget
+    assert pb1["feedback_mode"] == "record"
+    assert pb1["feedback_overrides"] == 0  # record NEVER changes estimates
+    assert pb1["verdict"] == "spill", pb1
+    med1, _mx1, n1 = s1.feedback_store.err_stats()
+    assert n1 > 0 and med1 is not None
+    entries, nbytes = s1.feedback_store.usage()
+    assert entries > 0 and nbytes > 0
+
+    s2 = _gate_session(tmp_path, "on")
+    out2 = s2.sql(GATE_Q).to_pylist()
+    pb2 = s2.last_plan_budget
+    assert out2 == out1  # feedback may replan, never change answers
+    assert pb2["feedback_hits"] > 0
+    assert pb2["feedback_overrides"] >= 1
+    assert pb2["verdict"] == "direct", pb2  # measured rows fit the budget
+    assert pb2["peak_bytes"] < pb1["peak_bytes"]
+    med2, _mx2, n2 = s2.feedback_store.err_stats()
+    assert n2 > 0
+    assert med2 < med1, (med1, med2)  # the error is a SHRINKING number
+
+
+def test_feedback_off_is_static_and_silent(tmp_path):
+    """Mode `off`: no store lookups, no recording, no annotations — the
+    pre-feedback static model, byte-for-byte."""
+    s = _gate_session(tmp_path, "off")
+    s.sql(GATE_Q).to_pylist()
+    pb = s.last_plan_budget
+    assert pb["feedback_mode"] == "off"
+    assert pb["feedback_hits"] == 0 and pb["feedback_overrides"] == 0
+    assert not os.path.isdir(str(tmp_path / "fb"))  # nothing ever written
+
+
+def test_scale_tag_change_invalidates_into_clean_miss(tmp_path):
+    """Re-registering the table with DIFFERENT data (row count) changes
+    the scale tag, so run 2's keys miss instead of consuming stale
+    cardinalities recorded against the old data."""
+    s1 = _gate_session(tmp_path, "record")
+    s1.sql(GATE_Q).to_pylist()
+    assert s1.feedback_store.usage()[0] > 0
+    # same query, same store dir, but the table is a different size
+    s2 = _gate_session(tmp_path, "on", table=_misest_table(n=100_000))
+    s2.sql(GATE_Q).to_pylist()
+    pb = s2.last_plan_budget
+    assert pb["feedback_hits"] == 0 and pb["feedback_overrides"] == 0
+    assert s2.feedback_store.stats["misses"] > 0
+
+
+def test_mode_resolution_and_validation(monkeypatch):
+    assert FB.resolve_feedback_mode({}) == "record"  # the default
+    assert FB.resolve_feedback_mode({"engine.plan_feedback": "on"}) == "on"
+    monkeypatch.setenv("NDS_PLAN_FEEDBACK", "off")
+    assert FB.resolve_feedback_mode({}) == "off"
+    with pytest.raises(ValueError):
+        FB.resolve_feedback_mode({"engine.plan_feedback": "always"})
+    monkeypatch.setenv("NDS_FEEDBACK_DIR", "0")
+    assert FB.resolve_feedback_dir({}) is None  # "0" disables the store
+    monkeypatch.setenv("NDS_FEEDBACK_DIR", "/some/dir")
+    assert FB.resolve_feedback_dir({}) == "/some/dir"
+    assert FB.resolve_feedback_dir(
+        {"engine.feedback_dir": "/conf/dir"}
+    ) == "/conf/dir"  # conf wins over env
+
+
+# ---------------------------------------------------------------------------
+# store units: the aot-cache persistence discipline, re-proven here
+# ---------------------------------------------------------------------------
+
+
+def test_record_flush_lookup_roundtrip(tmp_path):
+    st = _store(tmp_path)
+    err = st.record(FP_A, rows=1000, nbytes=8000, est_rows=10)
+    assert err == pytest.approx(abs(np.log(10) - np.log(1000)))
+    st.record(FP_A, rows=1200, nbytes=9600, est_rows=10)
+    st.record_skew(FP_A, 5.16, retries=2)
+    assert st.flush() == 1
+    # a FRESH store instance (new process stand-in) reads it back
+    st2 = _store(tmp_path)
+    rec = st2.lookup(FP_A)
+    assert rec["rows"]["n"] == 2
+    assert rec["rows"]["max"] == 1200 and rec["rows"]["min"] == 1000
+    assert rec["skew"]["max"] == pytest.approx(5.16)
+    assert rec["skew"]["retries"] == 2
+    assert st2.lookup(FP_B) is None
+    assert st2.stats["hits"] == 1 and st2.stats["misses"] == 1
+    assert st2.hit_rate() == 0.5
+
+
+def test_corrupt_entry_quarantines_as_miss(tmp_path):
+    st = _store(tmp_path)
+    st.record(FP_A, rows=7, est_rows=7)
+    st.flush()
+    [name] = [n for n in os.listdir(st.dir) if n.startswith("fb-")]
+    path = os.path.join(st.dir, name)
+    with open(path, "wb") as f:
+        f.write(b"{torn json" + os.urandom(16))
+    st2 = _store(tmp_path)
+    assert st2.lookup(FP_A) is None  # a miss, never a crash
+    assert st2.stats["quarantined"] == 1
+    names = os.listdir(st.dir)
+    assert not any(n.startswith("fb-") for n in names)
+    assert any(n.startswith("quarantine-") for n in names)
+    # checksum mismatch (valid JSON, tampered body) quarantines too
+    st2.record(FP_A, rows=7, est_rows=7)
+    st2.flush()
+    with open(path, "rb") as f:
+        doc = json.loads(f.read())
+    doc["body"]["rows"]["max"] = 999999
+    with open(path, "wb") as f:
+        f.write(json.dumps(doc).encode())
+    st3 = _store(tmp_path)
+    assert st3.lookup(FP_A) is None
+    assert st3.stats["quarantined"] == 1
+
+
+def test_foreign_key_is_clean_miss_not_quarantine(tmp_path):
+    """A valid document whose embedded key is another fp (filename-hash
+    collision stand-in): a clean miss — real data is never destroyed."""
+    st = _store(tmp_path)
+    st.record(FP_A, rows=7, est_rows=7)
+    st.flush()
+    src = os.path.join(st.dir, FB._entry_name(FP_A))
+    os.rename(src, os.path.join(st.dir, FB._entry_name(FP_B)))
+    st2 = _store(tmp_path)
+    assert st2.lookup(FP_B) is None
+    assert st2.stats["quarantined"] == 0
+    assert os.path.exists(os.path.join(st.dir, FB._entry_name(FP_B)))
+
+
+def test_two_process_share_through_one_dir(tmp_path):
+    """A child PROCESS records and flushes; the parent's store sees the
+    merged record — the serve-fleet sharing contract, minus jax."""
+    st = _store(tmp_path)
+    st.record(FP_A, rows=100, est_rows=10)
+    st.flush()
+    script = textwrap.dedent(f"""
+        from nds_tpu.analysis.feedback import FeedbackStore
+        st = FeedbackStore({str(tmp_path / "fb")!r}, 1 << 30)
+        st.record({FP_A!r}, rows=400, est_rows=10)
+        st.record_skew({FP_A!r}, 3.5, retries=1)
+        assert st.flush() == 1
+        print("SHARED")
+    """)
+    env = dict(os.environ, PYTHONPATH=os.pathsep.join(sys.path))
+    p = subprocess.run(
+        [sys.executable, "-c", script], env=env,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "SHARED" in p.stdout
+    st2 = _store(tmp_path)
+    rec = st2.lookup(FP_A)
+    assert rec["rows"]["n"] == 2  # parent's + child's observations merged
+    assert rec["rows"]["max"] == 400
+    assert rec["skew"]["retries"] == 1
+    assert not any(".tmp-" in n for n in os.listdir(st.dir))
+
+
+def test_vacuum_sweeps_dead_temps_and_quarantines(tmp_path):
+    st = _store(tmp_path)
+    st.record(FP_A, rows=7)
+    st.flush()
+    dead = os.path.join(st.dir, f"{FB._entry_name(FP_B)}.tmp-999999-aa")
+    with open(dead, "wb") as f:
+        f.write(b"torn")
+    live = os.path.join(st.dir, f"{FB._entry_name(FP_B)}.tmp-{os.getpid()}-bb")
+    with open(live, "wb") as f:
+        f.write(b"in-flight")
+    quar = os.path.join(st.dir, f"quarantine-{FB._entry_name(FP_B)}.1")
+    with open(quar, "wb") as f:
+        f.write(b"bad")
+    removed = st.vacuum()
+    assert removed == 2  # the dead temp + the quarantine; never the live
+    assert os.path.exists(live) and not os.path.exists(dead)
+    assert not os.path.exists(quar)
+    assert st.lookup(FP_A) is not None  # committed entries survive
+    os.unlink(live)
+    assert st.vacuum(drop_all=True) >= 1
+    assert st.usage() == (0, 0)
+    st2 = _store(tmp_path)
+    assert st2.lookup(FP_A) is None
+
+
+def test_lru_eviction_holds_byte_budget(tmp_path):
+    st = _store(tmp_path)
+    st.record(FP_A, rows=7, est_rows=7)
+    assert st.flush() == 1
+    _, size_a = st.usage()
+    # budget admits ~one entry: the NEXT flush must evict the older one
+    st.budget = int(size_a * 1.5)
+    old = os.path.join(st.dir, FB._entry_name(FP_A))
+    os.utime(old, (1, 1))  # backdate: FP_A is the LRU victim
+    st.record(FP_B, rows=9, est_rows=9)
+    assert st.flush() == 1
+    assert st.stats["evictions"] >= 1
+    assert not os.path.exists(old)
+    names = [n for n in os.listdir(st.dir) if n.startswith("fb-")]
+    assert names == [FB._entry_name(FP_B)]
+    entries, total = st.usage()
+    assert entries == 1 and total <= st.budget
