@@ -48,6 +48,7 @@ import threading
 import time
 
 from ..engine import plan as P
+from ..engine.lockdebug import make_lock
 
 #: plan_feedback modes (parallel to budget.MODES)
 FEEDBACK_MODES = ("off", "record", "on")
@@ -267,12 +268,12 @@ class FeedbackStore:
     def __init__(self, dirpath: str, budget_bytes: int):
         self.dir = dirpath
         self.budget = int(budget_bytes)
-        self._lock = threading.Lock()
-        self._mem = {}  # fp -> record dict (None = known miss)
-        self._pending = {}  # fp -> record delta awaiting flush
-        self._disabled = False  # first real write error disables stores
-        self._err_samples = []  # |log(est/actual)| ring (bench/statusz)
-        self.stats = {
+        self._lock = make_lock("FeedbackStore._lock")
+        self._mem = {}  # fp -> record dict (None = known miss)  # nds-guarded-by: _lock
+        self._pending = {}  # fp -> record delta awaiting flush  # nds-guarded-by: _lock
+        self._disabled = False  # first write error disables stores  # nds-guarded-by: _lock
+        self._err_samples = []  # |log(est/actual)| ring  # nds-guarded-by: _lock
+        self.stats = {  # nds-guarded-by: _lock
             "lookups": 0, "hits": 0, "misses": 0, "records": 0,
             "skew_records": 0, "flushes": 0, "stores": 0, "evictions": 0,
             "quarantined": 0, "overrides": 0,
@@ -289,12 +290,12 @@ class FeedbackStore:
                 rec = self._mem[fp]
                 self.stats["hits" if rec is not None else "misses"] += 1
                 return dict(rec) if rec is not None else None
-            rec = self._load(fp)
+            rec = self._load_locked(fp)
             self._mem[fp] = rec
             self.stats["hits" if rec is not None else "misses"] += 1
             return dict(rec) if rec is not None else None
 
-    def _load(self, fp: str):
+    def _load_locked(self, fp: str):
         path = os.path.join(self.dir, _entry_name(fp))
         try:
             with open(path, "rb") as f:
@@ -302,18 +303,18 @@ class FeedbackStore:
         except FileNotFoundError:
             return None
         except (OSError, ValueError, UnicodeDecodeError):
-            self._quarantine(path)
+            self._quarantine_locked(path)
             return None
         body = doc.get("body") if isinstance(doc, dict) else None
         key = doc.get("key") if isinstance(doc, dict) else None
         if not isinstance(body, dict) or not isinstance(key, dict):
-            self._quarantine(path)
+            self._quarantine_locked(path)
             return None
         want = hashlib.sha256(
             json.dumps(body, sort_keys=True).encode("utf-8")
         ).hexdigest()
         if doc.get("sha256") != want:
-            self._quarantine(path)
+            self._quarantine_locked(path)
             return None
         if key != self._key(fp):
             # full-key mismatch after a filename-hash collision or a
@@ -379,17 +380,17 @@ class FeedbackStore:
             for fp, delta in pending.items():
                 base = self._mem.get(fp)
                 if base is None:
-                    base = self._load(fp) or {}
+                    base = self._load_locked(fp) or {}
                 merged = self._merge(dict(base), delta)
                 merged["updated"] = int(time.time())
-                if self._write(fp, merged):
+                if self._write_locked(fp, merged):
                     self._mem[fp] = merged
                     written.append(_entry_name(fp))
                     self.stats["stores"] += 1
                 if self._disabled:
                     break
             if written:
-                self._enforce_budget(keep=set(written))
+                self._enforce_budget_locked(keep=set(written))
             return len(written)
 
     @staticmethod
@@ -418,7 +419,7 @@ class FeedbackStore:
                                int(d.get("retries", 0)))
         return base
 
-    def _write(self, fp: str, body: dict) -> bool:
+    def _write_locked(self, fp: str, body: dict) -> bool:
         doc = {
             "key": self._key(fp),
             "body": body,
@@ -450,7 +451,7 @@ class FeedbackStore:
                 pass
             return False
 
-    def _quarantine(self, path: str):
+    def _quarantine_locked(self, path: str):
         self.stats["quarantined"] += 1
         dest = os.path.join(
             os.path.dirname(path),
@@ -480,7 +481,7 @@ class FeedbackStore:
             out.append((st.st_mtime, st.st_size, n, path))
         return out
 
-    def _enforce_budget(self, keep=frozenset()):
+    def _enforce_budget_locked(self, keep=frozenset()):
         entries = self._entries()
         total = sum(e[1] for e in entries)
         if total <= self.budget:
@@ -550,7 +551,7 @@ class FeedbackStore:
                 self._mem.clear()
                 self._pending.clear()
             before = self.stats["evictions"]
-            self._enforce_budget()
+            self._enforce_budget_locked()
             removed += self.stats["evictions"] - before
         return removed
 

@@ -72,12 +72,31 @@ Every rule below encodes a bug this codebase actually shipped (and fixed):
                           arbitration, the fence check, and the
                           coordinator's WAL. Scope: everywhere except
                           the two committer modules.
-  cache-lock-discipline   the serve work (ROADMAP item 4) makes the
-                          session caches (exec_cache, join_order_cache,
-                          pallas_promotions, plan_cache) multi-tenant;
-                          every mutation outside a held session lock
-                          (`with session.cache_lock:`) is a latent race.
-                          Scope: everywhere.
+  guarded-by              the concurrency contract (analysis/
+                          concurrency.py): every mutation of declared-
+                          shared state (`# nds-guarded-by: <lock>` at the
+                          initialising assignment) must sit inside a
+                          `with <lock>:` span, and every attr a
+                          MULTITHREAD_CLASSES class mutates outside
+                          __init__ must be declared. Subsumes PR-7's
+                          `cache-lock-discipline` (the Session-cache half
+                          is its old body; the old name still works in
+                          pragmas via RULE_ALIASES). Scope: everywhere.
+  blocking-under-lock     no fs/network/jit-compile/sleep call inside a
+                          `with <lock>:` span — a syscall under a hot
+                          lock convoys every thread behind it. Scope:
+                          everywhere (analysis/concurrency.py).
+  lock-order              tree-wide (run_lock_order_lint): the static
+                          lock-acquisition graph (nested `with` spans +
+                          call edges) must stay acyclic and match
+                          anchors/lock_order.golden; regenerate with
+                          `--write-lock-order`. engine.lock_debug asserts
+                          the same pinned order at runtime.
+  thread-leak             every `threading.Thread(` must be daemonized
+                          or have its handle `.join()`ed in the same
+                          module (the PR-2 child-handle class, for
+                          threads). Scope: everywhere (analysis/
+                          concurrency.py).
   scan-path-listing       the PR-16 zone-map invariant: the scan path
                           discovers table files ONLY through the pinned
                           manifest (TableSnapshot.files()/file_stats()),
@@ -107,8 +126,14 @@ import sys
 from dataclasses import dataclass
 
 #: rule registry: name -> (scope predicate over package-relative path,
-#: checker). Populated at module bottom.
+#: checker). Populated at module bottom (and by analysis/concurrency.py,
+#: imported at the bottom of this module so its rules always register).
 RULES = {}
+
+#: retired rule name -> successor: pragmas written against the old name
+#: keep silencing the rule that absorbed it (`cache-lock-discipline` ->
+#: `guarded-by`, registered by analysis/concurrency.py)
+RULE_ALIASES = {}
 
 _PRAGMA_RE = re.compile(r"#\s*nds-lint:\s*disable=([A-Za-z0-9_,\- ]+)")
 
@@ -667,137 +692,6 @@ def _r_undocumented_conf_knob(tree, relpath):
     return out
 
 
-#: session-level caches whose mutation must hold the session cache lock
-#: (Session.cache_lock): the serve work (ROADMAP item 4) makes these
-#: multi-tenant, and every unguarded mutation is a latent race today.
-#: `aot_cache` (the persistent executable cache) and `promotion_store`
-#: (the persisted A/B verdicts) are internally locked AND cross-process
-#: atomic (tempfile+rename), but their session-level mutation sites hold
-#: the same discipline so a future refactor cannot silently regress them.
-_GUARDED_CACHES = (
-    "exec_cache", "join_order_cache", "pallas_promotions", "plan_cache",
-    "aot_cache", "promotion_store", "feedback_store",
-)
-
-#: attribute calls that mutate a cache object (ExecutableCache.lookup
-#: builds + inserts; AotCache.store/vacuum write + unlink entries;
-#: PromotionStore.record merges a verdict; FeedbackStore.lookup caches
-#: misses, record/record_skew buffer deltas, flush commits them;
-#: OrderedDict/dict mutators). Plain `.get`/`.load` reads are not
-#: flagged — the LRU caches' own get() sites are lock-wrapped anyway.
-_CACHE_MUTATORS = (
-    "clear", "put", "pop", "popitem", "update", "setdefault", "lookup",
-    "store", "vacuum", "record", "record_skew", "flush",
-)
-
-
-def _chain_cache_name(expr):
-    """The guarded-cache attribute name reachable in an expression's
-    attribute chain (session.exec_cache.map -> "exec_cache"), or None."""
-    for x in ast.walk(expr):
-        if isinstance(x, ast.Attribute) and x.attr in _GUARDED_CACHES:
-            return x.attr
-    return None
-
-
-@_rule("cache-lock-discipline", _scope_all)
-def _r_cache_lock_discipline(tree, relpath):
-    # with-blocks whose context expression names a lock: everything inside
-    # their line span is considered guarded (the AST has no aliasing
-    # analysis; a lock held by a caller needs a justified pragma)
-    lock_spans = []
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.With, ast.AsyncWith)):
-            for item in node.items:
-                names = [
-                    x.attr for x in ast.walk(item.context_expr)
-                    if isinstance(x, ast.Attribute)
-                ] + [
-                    x.id for x in ast.walk(item.context_expr)
-                    if isinstance(x, ast.Name)
-                ]
-                if any(n.endswith("lock") for n in names):
-                    lock_spans.append((node.lineno, node.end_lineno))
-                    break
-
-    def guarded(line):
-        return any(a <= line <= b for a, b in lock_spans)
-
-    # local-alias taint: `cache = self._session_cache()` / `c = s.plan_cache`
-    # / `c = getattr(s, "plan_cache", None)` — the string-constant getattr
-    # form reaches the same object with no Attribute node, so without it
-    # an alias could silently dodge the rule
-    def _getattr_cache_name(src):
-        if (
-            isinstance(src, ast.Call)
-            and isinstance(src.func, ast.Name)
-            and src.func.id == "getattr"
-            and len(src.args) >= 2
-            and isinstance(src.args[1], ast.Constant)
-            and src.args[1].value in _GUARDED_CACHES
-        ):
-            return src.args[1].value
-        return None
-
-    tainted = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Assign) and isinstance(
-            node.value, (ast.Attribute, ast.Call)
-        ):
-            src = node.value
-            hit = (
-                _chain_cache_name(src) is not None
-                or _getattr_cache_name(src) is not None
-                or (
-                    isinstance(src, ast.Call)
-                    and isinstance(src.func, ast.Attribute)
-                    and src.func.attr == "_session_cache"
-                )
-            )
-            if hit:
-                for t in node.targets:
-                    if isinstance(t, ast.Name):
-                        tainted.add(t.id)
-
-    def receiver_is_cache(value):
-        if _chain_cache_name(value) is not None:
-            return True
-        return isinstance(value, ast.Name) and value.id in tainted
-
-    out = []
-    for node in ast.walk(tree):
-        line = msg = None
-        if (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Attribute)
-            and node.func.attr in _CACHE_MUTATORS
-            and receiver_is_cache(node.func.value)
-        ):
-            line = node.lineno
-            msg = f".{node.func.attr}() on a session cache"
-        elif isinstance(node, (ast.Assign, ast.AugAssign)):
-            targets = (
-                node.targets if isinstance(node, ast.Assign) else [node.target]
-            )
-            for t in targets:
-                if isinstance(t, ast.Subscript) and receiver_is_cache(t.value):
-                    line = node.lineno
-                    msg = "subscript store into a session cache"
-        elif isinstance(node, ast.Delete):
-            for t in node.targets:
-                if isinstance(t, ast.Subscript) and receiver_is_cache(t.value):
-                    line = node.lineno
-                    msg = "subscript delete from a session cache"
-        if line is not None and not guarded(line):
-            out.append((line, (
-                f"{msg} outside a held session lock "
-                f"(`with session.cache_lock:`); exec/join-order/pallas/"
-                f"plan caches go multi-tenant under the serve work and "
-                f"every unguarded mutation is a latent race"
-            )))
-    return out
-
-
 #: directory-listing calls the scan path must not make: file discovery
 #: goes through the pinned manifest (TableSnapshot.files()/dataset()),
 #: never the filesystem — a raw listing sees uncommitted staged files,
@@ -935,6 +829,9 @@ def lint_source(src: str, relpath: str) -> list[Finding]:
     """Lint one file's source under its package-relative path (the path
     selects which rules apply)."""
     tree = ast.parse(src)
+    # comment-level annotations (`# nds-guarded-by:`) are invisible to the
+    # AST; rules that need them read the source off the tree
+    tree._nds_lint_source = src
     pragmas = _pragmas(src)
     findings = []
     for name, (scope, check) in RULES.items():
@@ -942,6 +839,7 @@ def lint_source(src: str, relpath: str) -> list[Finding]:
             continue
         for line, message in check(tree, relpath):
             disabled = pragmas.get(line, set()) | pragmas.get(line - 1, set())
+            disabled |= {RULE_ALIASES.get(r, r) for r in disabled}
             if name in disabled or "all" in disabled:
                 continue
             findings.append(Finding(relpath, line, name, message))
@@ -986,6 +884,11 @@ def run_lint(root: str | None = None) -> list[Finding]:
     # rules cannot see the whole read set, so it runs once here, reusing
     # the mention set gathered above instead of re-reading the tree
     findings.extend(run_unread_knob_lint(root, mentioned=mentioned))
+    # tree-wide lock-order pass (cycles + golden sync): the acquisition
+    # graph spans call edges between files, so it cannot be a per-file rule
+    from . import concurrency
+
+    findings.extend(concurrency.run_lock_order_lint(root))
     return findings
 
 
@@ -1002,10 +905,20 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--list-rules", action="store_true", help="print the rule table"
     )
+    ap.add_argument(
+        "--write-lock-order", action="store_true",
+        help="regenerate anchors/lock_order.golden from the current tree "
+             "(review the diff: every new edge is a new nested acquisition)",
+    )
     args = ap.parse_args(argv)
     if args.list_rules:
         for name in sorted(RULES):
             print(name)
+        return 0
+    if args.write_lock_order:
+        from . import concurrency
+
+        print(f"lint: wrote {concurrency.write_golden(args.root)}")
         return 0
     findings = run_lint(args.root)
     for f in findings:
@@ -1014,6 +927,11 @@ def main(argv=None) -> int:
     print(f"lint: {n} finding(s)" if n else "lint: clean")
     return 1 if findings else 0
 
+
+# registers the concurrency rules (guarded-by / blocking-under-lock /
+# thread-leak) into RULES and the cache-lock-discipline alias — imported
+# last so the substrate above is fully defined either import order
+from . import concurrency as _concurrency  # noqa: E402,F401
 
 if __name__ == "__main__":
     sys.exit(main())

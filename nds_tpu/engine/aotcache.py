@@ -68,6 +68,7 @@ import time
 
 from .. import faults
 from .. import __version__ as _NDS_VERSION
+from .lockdebug import make_lock
 
 _MAGIC = b"NDSAOT1\n"
 _ENTRY_PREFIX = "aot-"
@@ -219,7 +220,7 @@ class AotCache:
         # swapped mid-run by harness loops; capturing the object would
         # emit into a closed file)
         self._tracer = tracer if callable(tracer) else (lambda: tracer)
-        self._lock = threading.Lock()
+        self._lock = make_lock("AotCache._lock")
         self._env = environment_key()
         # bounded LRU: the tuple's strong dictionary ref keeps the id()
         # key truthful, and the cap keeps a long-lived serving session
@@ -227,13 +228,14 @@ class AotCache:
         # hashed (a dropped entry just re-hashes, compile-level rarity)
         from collections import OrderedDict
 
-        self._dict_hashes = OrderedDict()  # id(dic) -> (dic, hash)
+        # id(dic) -> (dic, hash)                 # nds-guarded-by: _lock
+        self._dict_hashes = OrderedDict()
         self._dict_hash_cap = 512
-        self.stats = {
+        self.stats = {  # nds-guarded-by: _lock
             "lookups": 0, "disk_hits": 0, "misses": 0, "stores": 0,
             "store_failures": 0, "quarantined": 0, "evictions": 0,
         }
-        self._store_disabled = False
+        self._store_disabled = False  # nds-guarded-by: _lock
 
     # -- events ----------------------------------------------------------
     def _emit(self, op: str, result: str, **extra):
@@ -639,8 +641,9 @@ class PromotionStore:
 
     def __init__(self, cache_dir: str):
         self.path = os.path.join(str(cache_dir), _PROMO_FILE)
-        self._lock = threading.Lock()
-        self._cache = None  # last-read snapshot (refreshed on record)
+        self._lock = make_lock("PromotionStore._lock")
+        # last-read snapshot (refreshed on record)  # nds-guarded-by: _lock
+        self._cache = None
 
     def _read(self) -> dict:
         try:
@@ -664,20 +667,28 @@ class PromotionStore:
             data = self._read()
             data[key_str] = rec
             self._cache = data
-            tmp = (
-                f"{self.path}.tmp-{os.getpid()}-"
-                f"{hashlib.sha256(os.urandom(8)).hexdigest()[:6]}"
-            )
+        # file IO OUTSIDE the lock: `get` is on the planning path and
+        # shares it, so a slow store write would convoy every planner
+        # behind a syscall (the blocking-under-lock class). Two
+        # concurrent record()s may interleave here — last rename wins the
+        # whole snapshot, dropping at most one record (the documented
+        # race; the next session re-measures). `data` is private to this
+        # call: _read() builds a fresh dict and nothing mutates _cache
+        # in place.
+        tmp = (
+            f"{self.path}.tmp-{os.getpid()}-"
+            f"{hashlib.sha256(os.urandom(8)).hexdigest()[:6]}"
+        )
+        try:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(data, f, sort_keys=True)
+            os.replace(tmp, self.path)
+        except OSError:
             try:
-                os.makedirs(os.path.dirname(self.path), exist_ok=True)
-                with open(tmp, "w", encoding="utf-8") as f:
-                    json.dump(data, f, sort_keys=True)
-                os.replace(tmp, self.path)
+                os.unlink(tmp)
             except OSError:
-                try:
-                    os.unlink(tmp)
-                except OSError:
-                    pass
+                pass
 
     def count(self) -> int:
         return len(self._read())

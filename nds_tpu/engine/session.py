@@ -30,6 +30,7 @@ from .columnar import (
 from .exec import Executor
 from .sql import ast as A
 from .sql.parser import parse_sql, parse_script
+from .lockdebug import make_lock
 
 
 _PERSISTENT_CACHE_SET = False
@@ -162,7 +163,10 @@ class Catalog:
     def __init__(self, session):
         self.session = session
         self.entries = {}  # name -> _Entry
-        self._use_tick = 0
+        # recency tick for catalog-entry LRU: a lost increment under a
+        # concurrent bump only perturbs eviction recency, never
+        # correctness — unguarded by design
+        self._use_tick = 0  # nds-guarded-by: none
         # lakehouse pin holds (thread-local): table names whose snapshot
         # pin a DML statement froze for its own nested reads — auto-pin
         # must not re-resolve them mid-transaction (lakehouse/dml.py)
@@ -756,8 +760,8 @@ class Session:
         self.metrics = getattr(self.tracer, "sink", None)
         self.mesh = mesh
         self.catalog = Catalog(self)
-        self._listeners = []  # task-failure observers (harness parity)
-        self.plan_cache = _PlanResultCache(
+        self._listeners = []  # task-failure observers  # nds-guarded-by: cache_lock
+        self.plan_cache = _PlanResultCache(  # nds-guarded-by: cache_lock
             int(self.conf.get("engine.plan_cache_bytes", 1 << 30))
         )
         # fused-pipeline executable reuse (engine/fuse.py): survives catalog
@@ -767,7 +771,7 @@ class Session:
         # must not evict the stream-wide executables
         from .fuse import ExecutableCache
 
-        self.exec_cache = ExecutableCache(
+        self.exec_cache = ExecutableCache(  # nds-guarded-by: cache_lock
             int(self.conf.get("engine.exec_cache_entries", 512))
         )
         # persistent AOT executable cache (engine/aotcache.py): fused
@@ -829,7 +833,7 @@ class Session:
         # MultiJoin greedy-order memo: fingerprint -> recorded join steps
         # (exec._multijoin_greedy). Replaying skips the per-step blocking
         # row-count syncs of the cost scan on every re-execution.
-        self.join_order_cache = {}
+        self.join_order_cache = {}  # nds-guarded-by: cache_lock
         # Pallas promotion memo (engine.pallas_agg=auto): per
         # (fn, rows, group-cap) shape, the measured jnp-vs-Pallas A/B and
         # the winning route (exec._pallas_promoted). Session-lived: the
@@ -840,7 +844,9 @@ class Session:
         # (ROADMAP item 4) makes these multi-tenant, and the
         # cache-lock-discipline lint flags unguarded mutations. RLock: the
         # recovery path clears caches from inside already-locked regions.
-        self.cache_lock = threading.RLock()
+        self.cache_lock = make_lock(
+            "Session.cache_lock", self.conf, reentrant=True
+        )
         # static plan-budget verdict of the most recent statement
         # (analysis/budget.py budget_plan); the report ladder's first
         # device-OOM rung consumes the window recommendation
@@ -859,7 +865,7 @@ class Session:
         # per directory — the manifest/fingerprint-guarded orphan sweep)
         from .spill import resolve_spill_dir, sweep_at_session_start
 
-        self._spill_pool = None
+        self._spill_pool = None  # nds-guarded-by: cache_lock
         sweep_at_session_start(resolve_spill_dir(self.conf))
         # marker (like last_blocked_union): stats of the most recent
         # statement that routed through an out-of-core spill path; harness
@@ -869,7 +875,9 @@ class Session:
         # (monotonic seconds): the report watchdog re-arms while a healthy
         # out-of-core op keeps beating, so a long external sort is not
         # misclassified as a hang (report.BenchReport._attempt)
-        self._progress_ts = None
+        # single atomic tuple store, read by the report watchdog from
+        # another thread; an object-reference store cannot tear
+        self._progress_ts = None  # nds-guarded-by: none
 
     @property
     def spill_pool(self):
@@ -1032,18 +1040,22 @@ class Session:
 
     # ---- listeners (reference: python_listener/PythonListener.py) --------
     def register_listener(self, cb):
-        self._listeners.append(cb)
+        with self.cache_lock:
+            self._listeners.append(cb)
 
     def unregister_listener(self, cb):
-        try:
-            self._listeners.remove(cb)
-        except ValueError:
-            pass
+        with self.cache_lock:
+            try:
+                self._listeners.remove(cb)
+            except ValueError:
+                pass
 
     def notify_failure(self, reason: str):
         """Fan a recoverable task-failure event out to listeners (reference:
         jvm_listener Manager.notifyAll -> PythonListener.notify)."""
-        for cb in self._listeners:
+        with self.cache_lock:
+            listeners = list(self._listeners)
+        for cb in listeners:
             cb(reason)
 
     # ---- SQL -------------------------------------------------------------

@@ -46,6 +46,7 @@ from collections import OrderedDict
 import numpy as np
 
 from .. import faults
+from .lockdebug import make_lock
 
 #: default host-RAM budget for spilled segments — mirrors the reference's
 #: explicit executor-spill sizing (power_run_gpu.template pins host pools
@@ -158,15 +159,19 @@ class SpillPool:
         self.dir = spill_dir
         self.app = app_id or f"pid{os.getpid()}-{uuid.uuid4().hex[:6]}"
         self._seq = itertools.count()
-        self._lock = threading.Lock()
-        self._host = OrderedDict()  # sid -> segment (RAM-resident, LRU)
-        self._all = {}  # sid -> segment
-        self.host_bytes = 0
-        self.stats = {
+        self._lock = make_lock("SpillPool._lock")
+        # sid -> segment (RAM-resident, LRU)     # nds-guarded-by: _lock
+        self._host = OrderedDict()
+        self._all = {}  # sid -> segment          # nds-guarded-by: _lock
+        self.host_bytes = 0  # nds-guarded-by: _lock
+        self.stats = {  # nds-guarded-by: _lock
             "bytes_in": 0, "bytes_out": 0, "evictions": 0, "segments": 0,
         }
-        self._manifest_written = False
-        self._ram_only_warned = False
+        # idempotent once-flag set by the (unlocked, by design) disk-tier
+        # writer; duplicate manifest writes are atomic replaces of
+        # identical content
+        self._manifest_written = False  # nds-guarded-by: none
+        self._ram_only_warned = False  # nds-guarded-by: _lock
 
     # ------------------------------------------------------------------
     def put(self, table) -> SpillSegment:

@@ -76,6 +76,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+from .engine.lockdebug import make_lock
 
 # ---------------------------------------------------------------------------
 # failure taxonomy
@@ -253,7 +254,7 @@ class FaultRule:
 class FaultRegistry:
     def __init__(self, rules):
         self.rules = list(rules)
-        self._lock = threading.Lock()
+        self._lock = make_lock("FaultRegistry._lock")
 
     @classmethod
     def parse(cls, spec: str) -> "FaultRegistry":

@@ -73,6 +73,7 @@ import uuid
 
 from .. import faults
 from ..io.fs import get_fs, put_if_absent
+from ..engine.lockdebug import make_lock
 
 #: catalog state directory inside a table root, sibling of _manifests/
 CATALOG_DIR = "_catalog"
@@ -146,7 +147,7 @@ def _catalog_spec(conf: dict | None = None):
 #: client caches its (host, port); a dict keyed by spec keeps table
 #: construction at one lookup. nds-lint: disable=mutable-module-global
 _CLIENTS = {}
-_CLIENTS_LOCK = threading.Lock()
+_CLIENTS_LOCK = make_lock("lakehouse/catalog.py:_CLIENTS_LOCK")
 
 
 def resolve_catalog(conf: dict | None = None):
@@ -546,18 +547,23 @@ class CatalogCoordinator:
 
     def __init__(self, tracer=None):
         self._fs = FsCatalog()
-        self._lock = threading.Lock()
+        self._lock = make_lock("CatalogCoordinator._lock")
         self.tracer = tracer
-        self._refs = {}  # path -> _TableRef
+        self._refs = {}  # path -> _TableRef  # nds-guarded-by: _lock
         self.started_ts_ms = _now_ms()
         #: kept False so obs/httpserv.py's /healthz keeps answering 200
         self.draining = False
 
     def _ref(self, path: str) -> _TableRef:
-        ref = self._refs.get(path)
-        if ref is None:
-            ref = self._refs[path] = _TableRef(path)
-        return ref
+        # under the coordinator lock: handlers call this BEFORE their own
+        # `with self._lock:` span, and two listener threads racing the
+        # same path would otherwise each publish a distinct _TableRef —
+        # one of them then commits against a ref nobody else can see
+        with self._lock:
+            ref = self._refs.get(path)
+            if ref is None:
+                ref = self._refs[path] = _TableRef(path)
+            return ref
 
     def _bind(self):
         from ..obs import trace as obs_trace

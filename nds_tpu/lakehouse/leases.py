@@ -31,6 +31,7 @@ import itertools
 import os
 import threading
 from time import monotonic as _monotonic
+from ..engine.lockdebug import make_lock
 
 #: default reader-lease TTL in seconds (engine.lake_lease_ttl_s /
 #: NDS_LAKE_LEASE_TTL_S): long enough for any benchmarked query, short
@@ -59,9 +60,9 @@ class ReaderLeases:
     holds at most the leases of its last activity burst."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("ReaderLeases._lock")
         self._ids = itertools.count(1)
-        self._leases = {}  # id -> {root, version, files, expires}
+        self._leases = {}  # id -> lease record  # nds-guarded-by: _lock
 
     def acquire(self, root: str, version: int, files, ttl_s: float,
                 remote=None) -> int:
@@ -80,7 +81,7 @@ class ReaderLeases:
             "remote": remote,
         }
         with self._lock:
-            self._prune(_monotonic())
+            self._prune_locked(_monotonic())
             self._leases[lease_id] = rec
         return lease_id
 
@@ -95,7 +96,7 @@ class ReaderLeases:
         local pin."""
         now = _monotonic()
         with self._lock:
-            self._prune(now)
+            self._prune_locked(now)
             rec = self._leases.get(lease_id)
             if rec is None:
                 return False
@@ -130,7 +131,7 @@ class ReaderLeases:
                 pass  # remote TTL expiry is the backstop
         return rec is not None
 
-    def _prune(self, now: float):
+    def _prune_locked(self, now: float):
         dead = [i for i, r in self._leases.items() if r["expires"] <= now]
         for i in dead:
             del self._leases[i]
@@ -139,7 +140,7 @@ class ReaderLeases:
     def held_versions(self, root: str) -> set:
         root = str(root)
         with self._lock:
-            self._prune(_monotonic())
+            self._prune_locked(_monotonic())
             return {
                 r["version"] for r in self._leases.values()
                 if r["root"] == root
@@ -150,7 +151,7 @@ class ReaderLeases:
         root = str(root)
         out = set()
         with self._lock:
-            self._prune(_monotonic())
+            self._prune_locked(_monotonic())
             for r in self._leases.values():
                 if r["root"] == root:
                     out |= r["files"]
@@ -158,7 +159,7 @@ class ReaderLeases:
 
     def live_count(self, root: str | None = None) -> int:
         with self._lock:
-            self._prune(_monotonic())
+            self._prune_locked(_monotonic())
             if root is None:
                 return len(self._leases)
             root = str(root)

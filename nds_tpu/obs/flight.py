@@ -46,6 +46,7 @@ import time
 from collections import OrderedDict, deque
 
 from .. import __version__
+from ..engine.lockdebug import make_lock
 
 #: default ring capacity (events); enough to hold a failing query's last
 #: op spans + heartbeats from every live thread without unbounded memory
@@ -126,16 +127,18 @@ class FlightRecorder:
     MAX_PLANS = 8
 
     def __init__(self, capacity: int = DEFAULT_RING_EVENTS):
-        self._ring = deque(maxlen=capacity)
+        # bounded-deque appends are atomic under the GIL; the hot path
+        # stays lock-free on purpose (record rides every event emit)
+        self._ring = deque(maxlen=capacity)  # nds-guarded-by: none
         self.capacity = capacity
-        self.events_recorded = 0
-        self._lock = threading.Lock()
-        self._plans = OrderedDict()  # query label -> explain text
+        self.events_recorded = 0  # approximate under races  # nds-guarded-by: none
+        self._lock = make_lock("FlightRecorder._lock")
+        self._plans = OrderedDict()  # query label -> explain  # nds-guarded-by: _lock
 
     # -- hot path --------------------------------------------------------
     def record(self, ev: dict):
         self._ring.append(ev)
-        self.events_recorded += 1  # approximate under races; telemetry only
+        self.events_recorded += 1  # telemetry only
 
     # -- incident context ------------------------------------------------
     def note_plan(self, query, explain):
@@ -165,7 +168,8 @@ class FlightRecorder:
 
     # -- bundles ---------------------------------------------------------
     def bundle(self, reason: str, trace_id=None, query=None, plan=None,
-               budget=None, ladder=None, memory=None, conf=None) -> dict:
+               budget=None, ladder=None, memory=None, conf=None,
+               threads=None) -> dict:
         events = self.snapshot()
         if trace_id is None:
             # best effort: the newest ring event's stamped context
@@ -191,11 +195,17 @@ class FlightRecorder:
             "ladder": ladder,
             "memory": memory,
             "conf": redact_conf(conf) if conf else None,
+            # suspected-deadlock evidence (engine/lockdebug.py watchdog):
+            # {"stacks": {thread: [...frames]}, "locks": held-lock table}.
+            # Optional-extra rather than a BUNDLE_KEYS key: most bundles
+            # are not lock incidents, and the validate contract already
+            # tolerates extras
+            **({"threads": threads} if threads is not None else {}),
         }
 
     def flush(self, reason: str, trace_id=None, query=None, plan=None,
               budget=None, ladder=None, memory=None, conf=None,
-              out_dir=None):
+              out_dir=None, threads=None):
         """Write the bundle atomically; returns its path, or None when the
         write failed (forensics must never take the run down — a broken
         flight dir is reported once to stdout, not raised)."""
@@ -203,6 +213,7 @@ class FlightRecorder:
             b = self.bundle(
                 reason, trace_id=trace_id, query=query, plan=plan,
                 budget=budget, ladder=ladder, memory=memory, conf=conf,
+                threads=threads,
             )
             out_dir = out_dir or resolve_flight_dir()
             os.makedirs(out_dir, exist_ok=True)
@@ -272,7 +283,7 @@ def is_bundle_path(path) -> bool:
 # process-wide singleton (one black box per process, like the sink)
 # ---------------------------------------------------------------------------
 
-_SHARED_LOCK = threading.Lock()
+_SHARED_LOCK = make_lock("obs/flight.py:_SHARED_LOCK")
 _SHARED = {}  # "recorder": FlightRecorder
 
 

@@ -49,6 +49,7 @@ import json
 import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from ..engine.lockdebug import make_lock
 
 #: largest accepted POST body (a query request is SQL text + a small JSON
 #: envelope; anything bigger is a client bug or a flood)
@@ -57,7 +58,7 @@ MAX_BODY_BYTES = 8 << 20
 #: on-demand jax.profiler state (one profiler per process — jax itself
 #: enforces that); guarded by its lock because two /debug/jaxprof POSTs
 #: may race on the threading server
-_JAXPROF_LOCK = threading.Lock()
+_JAXPROF_LOCK = make_lock("obs/httpserv.py:_JAXPROF_LOCK")
 _JAXPROF = {"dir": None, "started_ts_ms": None}
 
 

@@ -88,15 +88,19 @@ class MemorySampler:
                 float(os.environ.get("NDS_TRACE_MEM_INTERVAL_MS", "50")) / 1000
             )
         self.interval_s = max(interval_s, 0.001)
-        self.peak_bytes = None
+        # single-writer discipline instead of a lock: every field below
+        # is mutated only by the sampler thread (_sample) or by the
+        # owner before start / after join (__enter__/__exit__), and the
+        # owner reads peaks only after __exit__'s join
+        self.peak_bytes = None  # nds-guarded-by: none
         #: per-device high-water (list, device-source runs only): the
         #: straggler-visible half of the peak — query_span carries it as
         #: `mem_hw_per_device` and /statusz's mesh section max-merges it
-        self.peak_per_device = None
+        self.peak_per_device = None  # nds-guarded-by: none
         self.source = None
         self.watermark_bytes = watermark_bytes or None
         self.on_watermark = on_watermark
-        self.watermark_fired = False
+        self.watermark_fired = False  # nds-guarded-by: none
         # heartbeat beacon (module docstring): emitted through `tracer`
         # (passed explicitly — thread-locals don't reach this thread)
         # at most every `heartbeat_s`; tracer None disables it
@@ -113,8 +117,8 @@ class MemorySampler:
                 / 1000
             )
         self.heartbeat_s = max(heartbeat_s, 0.0)
-        self._last_hb = None
-        self._t0 = None
+        self._last_hb = None  # nds-guarded-by: none
+        self._t0 = None  # nds-guarded-by: none
         self._stop = threading.Event()
         self._thread = None
         # probe once up front so source selection is stable for the run

@@ -40,6 +40,7 @@ import threading
 import time
 
 from .trace import EVENT_SCHEMA
+from ..engine.lockdebug import make_lock
 
 #: metric family -> the EVENT_SCHEMA kind that feeds it. The lint rule
 #: `trace-event-schema` (analysis/lint.py) enforces that every value is a
@@ -101,6 +102,8 @@ METRIC_KINDS = {
     "nds_heartbeat_total": "heartbeat",
     "nds_heartbeat_rss_bytes": "heartbeat",         # gauge (latest)
     "nds_heartbeat_elapsed_ms": "heartbeat",        # gauge (latest)
+    "nds_lock_contention_total": "lock_contention",
+    "nds_lock_contention_wait_ms": "lock_contention",  # histogram
     "nds_serve_request_total": "serve_request",
     "nds_serve_request_ms_total": "serve_request",
     "nds_serve_request_dur_ms": "serve_request",    # histogram (p99 scrape)
@@ -157,12 +160,17 @@ class MetricsRegistry:
     lock; there is no per-series allocation after first touch."""
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self._counters = {}   # (name, labels) -> float
-        self._gauges = {}     # (name, labels) -> float
-        self._hists = {}      # (name, labels) -> [bucket counts..., +Inf]
-        self._hist_sum = {}   # (name, labels) -> (sum, count)
-        self._types = {}      # family name -> "counter"|"gauge"|"histogram"
+        self._lock = make_lock("MetricsRegistry._lock")
+        # (name, labels) -> float                # nds-guarded-by: _lock
+        self._counters = {}
+        # (name, labels) -> float                # nds-guarded-by: _lock
+        self._gauges = {}
+        # (name, labels) -> [bucket cts, +Inf]   # nds-guarded-by: _lock
+        self._hists = {}
+        # (name, labels) -> (sum, count)         # nds-guarded-by: _lock
+        self._hist_sum = {}
+        # family -> counter|gauge|histogram      # nds-guarded-by: _lock
+        self._types = {}
 
     @staticmethod
     def _key(name, labels):
@@ -326,8 +334,8 @@ class MetricsSink:
 
     def __init__(self):
         self.registry = MetricsRegistry()
-        self._slock = threading.Lock()
-        self._status = {
+        self._slock = make_lock("MetricsSink._slock")
+        self._status = {  # nds-guarded-by: _slock
             "pid": os.getpid(),
             "started_ts_ms": int(time.time() * 1000),
             "phase": None,
@@ -348,12 +356,14 @@ class MetricsSink:
         # concurrently share the app id, so only the per-request id keeps
         # their in-flight records apart. Non-serve callers pass None and
         # keep the (app, query) semantics unchanged.
-        self._in_flight = {}
+        self._in_flight = {}  # nds-guarded-by: _slock
         # router-process hook (serve/router.py): a callable returning the
         # live fleet view (replica health, degraded capabilities, tenant
         # in-flight) merged into /statusz's "fleet" section at snapshot
         # time — the router owns that state, the sink only tallies events
-        self._fleet_provider = None
+        # single-reference swap, installed once at router startup;
+        # readers tolerate either value
+        self._fleet_provider = None  # nds-guarded-by: none
 
     def set_fleet_provider(self, fn):
         """Install the router's fleet-snapshot callable (or None to
@@ -885,6 +895,17 @@ class MetricsSink:
             })
             fleet["retries"] += 1
 
+    def _h_lock_contention(self, ev):
+        self.registry.inc(
+            "nds_lock_contention_total", lock=str(ev.get("lock") or "?")
+        )
+        # unlabeled histogram on purpose (like the request-duration one):
+        # the question is "how long do waits run fleet-wide", per-lock
+        # attribution comes from the counter + the event stream
+        self.registry.observe(
+            "nds_lock_contention_wait_ms", float(ev.get("wait_ms") or 0.0)
+        )
+
     def _h_heartbeat(self, ev):
         self.registry.inc("nds_heartbeat_total")
         if ev.get("rss_bytes") is not None:
@@ -1068,6 +1089,7 @@ _HANDLERS = {
     "serve_request": MetricsSink._h_serve_request,
     "route_request": MetricsSink._h_route_request,
     "route_retry": MetricsSink._h_route_retry,
+    "lock_contention": MetricsSink._h_lock_contention,
 }
 
 # every handled kind must be a real schema kind (drift breaks import, not
@@ -1079,7 +1101,7 @@ assert set(_HANDLERS) <= set(EVENT_SCHEMA)
 # process-wide singletons: one sink + one endpoint per process
 # ---------------------------------------------------------------------------
 
-_SHARED_LOCK = threading.Lock()
+_SHARED_LOCK = make_lock("obs/metrics.py:_SHARED_LOCK")
 _SHARED = {}  # "sink": MetricsSink, "server": MetricsServer, "warned": bool
 
 

@@ -57,6 +57,7 @@ import uuid
 
 from .. import faults
 from .. import __version__
+from ..engine.lockdebug import make_lock
 
 #: kind -> tuple of required per-kind fields (beyond ts/kind/app).
 #: Optional fields events may also carry are documented in README
@@ -218,6 +219,11 @@ EVENT_SCHEMA = {
     # Optional: dev_bytes (per-device HBM sample list, device-source
     # runs — feeds the /statusz mesh section's high-water)
     "heartbeat": ("query", "elapsed_ms", "rss_bytes"),
+    # runtime lock sanitizer (engine/lockdebug.py, engine.lock_debug):
+    # one acquisition whose wait crossed engine.lock_contention_ms.
+    # `lock` is the static model's name (ClassName.attr / relpath:NAME,
+    # anchors/lock_order.golden), wait_ms the measured acquire wait
+    "lock_contention": ("lock", "wait_ms"),
 }
 
 #: fields `Tracer.emit` stamps on EVERY event from the tracer's
@@ -402,19 +408,19 @@ class Tracer:
             ring = obs_flight.recorder()
         self.ring = ring or None
         self.rotate_bytes = max(int(rotate_bytes or 0), 0)
-        self.seq = 0
-        self.path = self._segment_path(0) if trace_dir else None
+        self.seq = 0  # nds-guarded-by: _lock
+        self.path = self._segment_path(0) if trace_dir else None  # nds-guarded-by: _lock
         if collect is None:
             collect = trace_dir is None
-        self.events: list[dict] | None = (
+        self.events: list[dict] | None = (  # nds-guarded-by: _lock
             [] if (trace_dir is None and collect) else None
         )
-        self._fh = None
-        self._lock = threading.Lock()
-        self._broken = False
-        self._closed = False
-        self._close_warned = False
-        self._seg_bytes = 0
+        self._fh = None  # nds-guarded-by: _lock
+        self._lock = make_lock("Tracer._lock")
+        self._broken = False  # nds-guarded-by: _lock
+        self._closed = False  # nds-guarded-by: _lock
+        self._close_warned = False  # nds-guarded-by: _lock
+        self._seg_bytes = 0  # nds-guarded-by: _lock
         if trace_dir:
             # eager meta line: the file exists (and is discoverable by a
             # parent/orchestrator) even if the process dies before its
@@ -489,9 +495,13 @@ class Tracer:
             try:
                 if self._fh is None:
                     parent = os.path.dirname(self.path)
+                    # lazy open under _lock is the design: this lock
+                    # exists to serialize exactly this segment handle,
+                    # the makedirs/open pair runs once per segment, and
+                    # emit serialized the payload before taking the lock.
                     if parent:
-                        os.makedirs(parent, exist_ok=True)
-                    self._fh = open(self.path, "a", encoding="utf-8")
+                        os.makedirs(parent, exist_ok=True)  # nds-lint: disable=blocking-under-lock
+                    self._fh = open(self.path, "a", encoding="utf-8")  # nds-lint: disable=blocking-under-lock
                     self._seg_bytes = os.fstat(self._fh.fileno()).st_size
                 data = line + "\n"
                 self._fh.write(data)
@@ -501,14 +511,14 @@ class Tracer:
                     # rotation can actually consume it
                     self._seg_bytes += len(data.encode("utf-8"))
                     if self._seg_bytes >= self.rotate_bytes:
-                        self._rotate()
+                        self._rotate_locked()
             except OSError as exc:
                 # observability must never take the benchmark down: an
                 # unwritable trace dir disables this tracer, loudly, once
                 self._broken = True
                 print(f"obs: disabling tracer ({self.path}: {exc})")
 
-    def _rotate(self):
+    def _rotate_locked(self):
         """Roll to the next segment (caller holds the lock). The new
         segment opens with its own trace_meta line (carrying `seq`) so a
         segment file found alone is still attributable to its process."""
@@ -535,7 +545,7 @@ class Tracer:
             self._closed = True
             if self._fh is not None:
                 self._fh.close()
-                self._fh = None
+                self._fh = None  # nds-guarded-by: _lock
 
 
 def tracer_from_conf(conf: dict | None = None, app_id: str | None = None,

@@ -20,6 +20,7 @@ import threading
 import time
 
 from ..io.fs import fs_open_atomic
+from ..engine.lockdebug import make_lock
 
 
 def resolve_job_dir(conf: dict | None = None) -> str:
@@ -52,8 +53,8 @@ class StreamJobs:
         self.job_dir = job_dir or resolve_job_dir(
             getattr(service.session, "conf", None)
         )
-        self._lock = threading.Lock()
-        self._jobs = {}  # job_id -> state dict (the live copy)
+        self._lock = make_lock("StreamJobs._lock")
+        self._jobs = {}  # job_id -> state dict  # nds-guarded-by: _lock
 
     # ------------------------------------------------------------------
     def _state_path(self, job_id: str) -> str:

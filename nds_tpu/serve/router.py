@@ -65,6 +65,7 @@ import time
 import uuid
 
 from .. import faults
+from ..engine.lockdebug import make_lock
 
 #: default per-request upstream attempt budget (first try + failovers)
 DEFAULT_ROUTE_RETRIES = 3
@@ -140,14 +141,14 @@ class Replica:
         self.port = int(port)
         self.name = f"{host}:{port}"
         self.mesh = mesh
-        self.healthy = True
-        self.draining = False
-        self.in_flight = 0
-        self.requests = 0
-        self.failures = 0
-        self.consecutive_errors = 0
-        self.last_latency_ms = None
-        self.last_probe_ok_ts = None
+        self.healthy = True  # nds-guarded-by: _lock
+        self.draining = False  # nds-guarded-by: _lock
+        self.in_flight = 0  # nds-guarded-by: _lock
+        self.requests = 0  # nds-guarded-by: _lock
+        self.failures = 0  # nds-guarded-by: _lock
+        self.consecutive_errors = 0  # nds-guarded-by: _lock
+        self.last_latency_ms = None  # nds-guarded-by: _lock
+        self.last_probe_ok_ts = None  # nds-guarded-by: _lock
 
     def snapshot(self) -> dict:
         return {
@@ -229,18 +230,18 @@ class QueryRouter:
             "NDS_ROUTE_REQUEST_TIMEOUT_S", DEFAULT_REQUEST_TIMEOUT_S,
             floor=1.0,
         )
-        self._lock = threading.Lock()
-        self._rr = 0
-        self._tenant_in_flight = {}
+        self._lock = make_lock("QueryRouter._lock")
+        self._rr = 0  # nds-guarded-by: _lock
+        self._tenant_in_flight = {}  # nds-guarded-by: _lock
         # (tenant, class) -> [tokens, last_refill_monotonic]
-        self._buckets = {}
+        self._buckets = {}  # nds-guarded-by: _lock
         # plan fingerprint -> /plan verdict payload (LRU via re-insert)
-        self._verdicts = {}
-        self._verdict_order = []
+        self._verdicts = {}  # nds-guarded-by: _lock
+        self._verdict_order = []  # nds-guarded-by: _lock
         # capability -> {"reason", "since_ts_ms"} while degraded
-        self._degraded = {}
-        self._dml_half_open_at = 0.0
-        self.draining = False
+        self._degraded = {}  # nds-guarded-by: _lock
+        self._dml_half_open_at = 0.0  # nds-guarded-by: _lock
+        self.draining = False  # nds-guarded-by: _lock
         self.started_ts_ms = int(time.time() * 1000)
         self._closed = threading.Event()
         self._prober = None
@@ -379,7 +380,10 @@ class QueryRouter:
 
     def close(self):
         self._closed.set()
-        self.draining = True
+        # under the router lock: an unlocked flip would not order against
+        # a concurrent handle_query's drain check on another thread
+        with self._lock:
+            self.draining = True
 
     # ------------------------------------------------------------------
     # transport
@@ -1042,5 +1046,6 @@ class QueryRouter:
     def handle_drain(self):
         """Drain the ROUTER: stop accepting (healthz flips 503 via the
         listener's draining contract); replicas are left running."""
-        self.draining = True
+        with self._lock:
+            self.draining = True
         return self._reply(200, {"draining": True, "drained": True})

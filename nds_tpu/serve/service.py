@@ -56,6 +56,7 @@ from ..engine.sql.parser import parse_script
 from ..obs import trace as obs_trace
 from ..obs.memwatch import rss_bytes
 from ..report import BenchReport, host_rss_watermark
+from ..engine.lockdebug import make_lock
 
 #: default rows per response page; `engine.serve_row_cap` overrides. A
 #: serve endpoint returning JSON must bound what one request can pull
@@ -164,7 +165,7 @@ class _RequestTracer:
         self._inner = inner
         self.request_id = request_id
         self.tenant = tenant
-        self._tally_lock = threading.Lock()
+        self._tally_lock = make_lock("_RequestTracer._tally_lock")
         self.tallies = {
             "exec_cache_hits": 0, "exec_cache_lookups": 0,
             "plan_cache_hits": 0, "plan_cache_lookups": 0,
@@ -239,23 +240,23 @@ class QueryService:
         # planning is serialized (Session.plan_sql holds cache_lock), but
         # the writer path needs its own mutual exclusion: one in-process
         # writer at a time, OCC arbitrates across processes
-        self._writer_lock = threading.Lock()
-        self._state_lock = threading.Lock()
-        self._in_flight = 0
-        self._active_rids = set()
+        self._writer_lock = make_lock("QueryService._writer_lock", conf)
+        self._state_lock = make_lock("QueryService._state_lock", conf)
+        self._in_flight = 0  # nds-guarded-by: _state_lock
+        self._active_rids = set()  # nds-guarded-by: _state_lock
         # /reload lease hygiene: [(rids-still-running-at-reload, lease
         # ids dropped by that reload)] — each batch releases when the
         # LAST of its in-flight statements finishes, instead of
         # abandoning the leases to TTL expiry (the PR-12 leak bound)
-        self._deferred_leases = []
-        self._tenant_in_flight = {}
+        self._deferred_leases = []  # nds-guarded-by: _state_lock
+        self._tenant_in_flight = {}  # nds-guarded-by: _state_lock
         # DML idempotency ledger (router retries): request_key -> the
         # recorded completed envelope, or None while the original
         # delivery is still running. Bounded FIFO — the keys are
         # router-minted uuids, one per client DML request.
-        self._dml_keys = {}
-        self._dml_key_order = []
-        self.draining = False
+        self._dml_keys = {}  # nds-guarded-by: _state_lock
+        self._dml_key_order = []  # nds-guarded-by: _state_lock
+        self.draining = False  # nds-guarded-by: _state_lock
         self.started_ts_ms = int(time.time() * 1000)
         from .jobs import StreamJobs
 
@@ -970,5 +971,9 @@ class QueryService:
         return self._reply(200, reloaded)
 
     def close(self):
-        """Terminal: stop admitting (tests + CLI shutdown). Idempotent."""
-        self.draining = True
+        """Terminal: stop admitting (tests + CLI shutdown). Idempotent.
+        The flag flips under _state_lock like handle_drain's: an unlocked
+        write would not order against _enter's post-acquire re-check, so
+        a request could start executing after close() returned."""
+        with self._state_lock:
+            self.draining = True

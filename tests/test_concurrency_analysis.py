@@ -1,0 +1,549 @@
+"""Concurrency contract analyzer (analysis/concurrency.py) + the runtime
+lock sanitizer (engine/lockdebug.py).
+
+Static half: one seeded violation per rule (unguarded mutation of
+declared state, undeclared shared attr, blocking call under a lock,
+lock-order cycle, thread leak), the `cache-lock-discipline` alias, and
+the lock-order golden sync — plus a lint-clean-tree assertion, the same
+gate ci/tier1-check enforces.
+
+Runtime half: the order assertion fires on a deliberately inverted
+acquisition, the `lock_contention` event matches its EVENT_SCHEMA row,
+and the hold-budget watchdog's suspected-deadlock dump lands in a flight
+bundle with the `threads` (stacks + held-lock table) section.
+
+Satellite regressions pin the real fixes this analyzer surfaced: the
+serve/router drain flips now run under their state locks, the
+promotion-store write moved its file IO outside the planning-path lock,
+and the catalog coordinator's `_ref` no longer races duplicate
+`_TableRef`s.
+"""
+
+import ast
+import json
+import os
+import textwrap
+import threading
+import time
+
+import pytest
+
+from nds_tpu.analysis import concurrency as C
+from nds_tpu.analysis import lint as L
+from nds_tpu.engine import lockdebug as ld
+from nds_tpu.obs import trace as obs_trace
+from nds_tpu.obs.trace import EVENT_SCHEMA, Tracer
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rules(src, relpath):
+    return [f.rule for f in L.lint_source(textwrap.dedent(src), relpath)]
+
+
+# ---------------------------------------------------------------------------
+# guarded-by: declarations + span discipline
+# ---------------------------------------------------------------------------
+
+
+def test_unguarded_mutation_of_declared_state_fires():
+    src = """
+    import threading
+
+    class QueryRouter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.draining = False  # nds-guarded-by: _lock
+
+        def close(self):
+            self.draining = True
+    """
+    assert _rules(src, "serve/router.py") == ["guarded-by"]
+
+
+def test_undeclared_shared_attr_fires():
+    src = """
+    import threading
+
+    class QueryRouter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._rr = 0
+
+        def bump(self):
+            with self._lock:
+                self._rr += 1
+    """
+    # mutated outside __init__ with no declaration: the model demands the
+    # contract be WRITTEN even when this one site happens to hold a lock
+    assert _rules(src, "serve/router.py") == ["guarded-by"]
+
+
+def test_declared_and_spanned_is_clean():
+    src = """
+    import threading
+
+    class QueryRouter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.draining = False  # nds-guarded-by: _lock
+
+        def close(self):
+            with self._lock:
+                self.draining = True
+    """
+    assert _rules(src, "serve/router.py") == []
+
+
+def test_guarded_by_none_and_locked_suffix_pass():
+    src = """
+    import threading
+
+    class QueryRouter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            # single atomic store, readers tolerate staleness
+            self.beat = None  # nds-guarded-by: none
+            self.n = 0  # nds-guarded-by: _lock
+
+        def stamp(self):
+            self.beat = 1.0
+
+        def _bump_locked(self):
+            self.n += 1
+    """
+    assert _rules(src, "serve/router.py") == []
+
+
+def test_non_multithread_class_is_exempt():
+    src = """
+    class Helper:
+        def poke(self):
+            self.x = 1
+    """
+    assert _rules(src, "serve/router.py") == []
+
+
+# ---------------------------------------------------------------------------
+# cache-lock-discipline, retired into guarded-by
+# ---------------------------------------------------------------------------
+
+_CACHE_SRC = """
+class Runner:
+    def go(self, session):
+        session.plan_cache.clear()
+"""
+
+
+def test_session_cache_rule_lives_on_in_guarded_by():
+    fs = L.lint_source(_CACHE_SRC, "power.py")
+    assert [f.rule for f in fs] == ["guarded-by"]
+    assert "cache_lock" in fs[0].message
+
+
+def test_cache_lock_discipline_alias_pragma_still_silences():
+    src = _CACHE_SRC.replace(
+        "session.plan_cache.clear()",
+        "session.plan_cache.clear()  # nds-lint: disable=cache-lock-discipline",
+    )
+    assert L.lint_source(src, "power.py") == []
+    assert C.RULE_ALIASES["cache-lock-discipline"] == "guarded-by" or \
+        L.RULE_ALIASES["cache-lock-discipline"] == "guarded-by"
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock
+# ---------------------------------------------------------------------------
+
+
+def test_blocking_call_under_lock_fires():
+    src = """
+    import json, os, threading
+
+    class PromotionStore:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cache = None  # nds-guarded-by: _lock
+
+        def record(self, rec):
+            with self._lock:
+                self._cache = rec
+                with open("/tmp/x", "w") as f:
+                    json.dump(rec, f)
+    """
+    rules = _rules(src, "engine/aotcache.py")
+    assert "blocking-under-lock" in rules
+
+
+def test_blocking_call_outside_lock_is_clean():
+    src = """
+    import json, threading
+
+    class PromotionStore:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cache = None  # nds-guarded-by: _lock
+
+        def record(self, rec):
+            with self._lock:
+                self._cache = rec
+            with open("/tmp/x", "w") as f:
+                json.dump(rec, f)
+    """
+    assert _rules(src, "engine/aotcache.py") == []
+
+
+# ---------------------------------------------------------------------------
+# thread-leak
+# ---------------------------------------------------------------------------
+
+
+def test_thread_leak_fires():
+    src = """
+    import threading
+
+    def go():
+        threading.Thread(target=print).start()
+    """
+    assert _rules(src, "power.py") == ["thread-leak"]
+
+
+def test_thread_leak_daemon_and_join_pass():
+    src = """
+    import threading
+
+    def go():
+        t = threading.Thread(target=print)
+        t.start()
+        t.join()
+        threading.Thread(target=print, daemon=True).start()
+    """
+    assert _rules(src, "power.py") == []
+
+
+def test_thread_leak_joined_via_list_iteration_passes():
+    # the throughput.py shape: handles built in a comprehension, joined
+    # through the loop variable — the loop-var -> iterable mapping must
+    # not flag it
+    src = """
+    import threading
+
+    def go(items):
+        threads = [threading.Thread(target=print, args=(i,)) for i in items]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    """
+    assert _rules(src, "power.py") == []
+
+
+# ---------------------------------------------------------------------------
+# lock-order: cycles + golden sync
+# ---------------------------------------------------------------------------
+
+
+def _write_tree(tmp_path, files):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return str(tmp_path)
+
+
+def test_lock_order_cycle_detected(tmp_path):
+    root = _write_tree(tmp_path, {
+        "mod.py": """
+        import threading
+
+        _A_LOCK = threading.Lock()
+        _B_LOCK = threading.Lock()
+
+        def forward():
+            with _A_LOCK:
+                with _B_LOCK:
+                    pass
+
+        def backward():
+            with _B_LOCK:
+                with _A_LOCK:
+                    pass
+        """,
+    })
+    model = C.build_lock_model(root)
+    assert model.cycles, "inverted nestings must form a cycle"
+    fs = C.run_lock_order_lint(root)
+    assert any(f.rule == "lock-order" and "cycle" in f.message for f in fs)
+
+
+def test_lock_order_nested_in_branches_and_call_edges(tmp_path):
+    # spans inside an `if` and acquisitions via a call edge both count
+    root = _write_tree(tmp_path, {
+        "mod.py": """
+        import threading
+
+        _A_LOCK = threading.Lock()
+        _B_LOCK = threading.Lock()
+
+        def inner():
+            with _B_LOCK:
+                pass
+
+        def outer(flag):
+            if flag:
+                with _A_LOCK:
+                    inner()
+        """,
+    })
+    model = C.build_lock_model(root)
+    assert ("mod.py:_A_LOCK", "mod.py:_B_LOCK") in model.edges
+    assert not model.cycles
+
+
+def test_golden_file_in_sync_with_tree():
+    # the checked-in golden IS the current model: regenerating must be a
+    # no-op (anything else fails lint before it fails here)
+    assert C.run_lock_order_lint() == []
+    model = C.build_lock_model()
+    assert not model.cycles
+    with open(C.golden_path(), encoding="utf-8") as f:
+        assert f.read() == C.format_golden(model)
+
+
+def test_golden_roundtrip_and_pinned_order():
+    order, edges = C.load_golden(C.golden_path())
+    model = C.build_lock_model()
+    assert order == model.order
+    assert edges == set(model.edges)
+    ranks = C.load_pinned_order()
+    # the runtime sanitizer consumes exactly this mapping
+    assert ranks["Session.cache_lock"] < ranks["FeedbackStore._lock"]
+    assert set(ranks) == set(order)
+
+
+def test_lint_clean_over_real_tree():
+    findings = L.run_lint(ROOT)
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_shared_state_report_smoke(capsys):
+    assert C.main(["--report"]) == 0
+    out = capsys.readouterr().out
+    assert "QueryRouter" in out
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizer (engine/lockdebug.py)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def lockdebug_on(monkeypatch):
+    monkeypatch.setenv("NDS_LOCK_DEBUG", "1")
+    ld.reset_for_tests()
+    yield
+    ld.reset_for_tests()
+
+
+def test_make_lock_is_plain_when_off(monkeypatch):
+    monkeypatch.delenv("NDS_LOCK_DEBUG", raising=False)
+    lk = ld.make_lock("Session.cache_lock")
+    assert not isinstance(lk, ld.DebugLock)
+    with lk:  # still a working lock
+        pass
+
+
+def test_sanitizer_catches_inverted_acquisition(lockdebug_on):
+    a = ld.make_lock("Session.cache_lock", reentrant=True)
+    b = ld.make_lock("FeedbackStore._lock")
+    assert isinstance(a, ld.DebugLock) and isinstance(b, ld.DebugLock)
+    with a:
+        with b:  # pinned order: cache_lock before the store lock
+            pass
+        with a:  # re-entrant re-acquire must not trip the assertion
+            pass
+    with b:
+        with pytest.raises(ld.LockOrderError, match="inversion"):
+            a.acquire()
+    assert ld.held_locks() == []  # bookkeeping unwound on both paths
+
+
+def test_unpinned_lock_names_skip_order_assertions(lockdebug_on):
+    a = ld.make_lock("Session.cache_lock")
+    x = ld.make_lock("NotInTheGolden._lock")
+    with x:
+        with a:  # no rank for x: nothing to assert
+            pass
+
+
+def test_contention_event_matches_schema(lockdebug_on):
+    lk = ld.DebugLock(
+        "SpillPool._lock", threading.Lock(),
+        contention_ms=5.0, hold_budget_s=0.0,
+    )
+    tr = Tracer(collect=True)
+
+    def holder():
+        with lk:
+            time.sleep(0.05)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    time.sleep(0.01)
+    with obs_trace.bind(tr):
+        with lk:
+            pass
+    t.join()
+    evs = [e for e in tr.events if e["kind"] == "lock_contention"]
+    assert evs, "a >=5ms wait must emit lock_contention"
+    for field in EVENT_SCHEMA["lock_contention"]:
+        assert field in evs[0], field
+    assert evs[0]["lock"] == "SpillPool._lock"
+    assert evs[0]["wait_ms"] >= 5.0
+
+
+def test_deadlock_dump_lands_in_flight_bundle(lockdebug_on, monkeypatch,
+                                              tmp_path):
+    monkeypatch.setenv("NDS_FLIGHT_DIR", str(tmp_path))
+    lk = ld.make_lock("AotCache._lock")
+    lk.acquire()
+    try:
+        time.sleep(0.02)
+        over = ld.check_holds(budget_s=0.01)
+    finally:
+        lk.release()
+    assert over and over[0]["lock"] == "AotCache._lock"
+    bundles = list(tmp_path.glob("failure-bundle-*.json"))
+    assert bundles, "the suspected-deadlock dump must write a bundle"
+    b = json.loads(bundles[0].read_text())
+    assert b["reason"].startswith("lock hold budget exceeded")
+    locks = b["threads"]["locks"]
+    assert any(r["lock"] == "AotCache._lock" for r in locks)
+    assert b["threads"]["stacks"], "all-thread stacks must be captured"
+    # one dump per hold: a second sweep over the same hold stays quiet
+    assert ld.check_holds(budget_s=0.01) == []
+
+
+def test_knob_resolvers():
+    assert ld.resolve_lock_debug({"engine.lock_debug": "on"}) is True
+    assert ld.resolve_lock_debug({}) is False
+    assert ld.resolve_contention_ms({"engine.lock_contention_ms": 7}) == 7.0
+    assert ld.resolve_contention_ms({"engine.lock_contention_ms": "junk"}) \
+        == 50.0
+    assert ld.resolve_hold_budget_s({"engine.lock_hold_budget_s": 0}) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# satellite regressions: the real unguarded sites the analyzer surfaced
+# ---------------------------------------------------------------------------
+
+
+def _with_span_covering(path, cls_name, fn_name, attr, lock_attr):
+    """True when every `self.<attr> = ...` in <cls>.<fn> sits inside a
+    `with self.<lock_attr>` span — the shape of each drain-flag fix."""
+    with open(os.path.join(ROOT, "nds_tpu", path), encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    cls = next(n for n in ast.walk(tree)
+               if isinstance(n, ast.ClassDef) and n.name == cls_name)
+    fn = next(n for n in ast.walk(cls)
+              if isinstance(n, ast.FunctionDef) and n.name == fn_name)
+    spans = [
+        (node.lineno, max(
+            x.lineno for x in ast.walk(node) if hasattr(x, "lineno")
+        ))
+        for node in ast.walk(fn) if isinstance(node, ast.With)
+        if any(
+            isinstance(it.context_expr, ast.Attribute)
+            and it.context_expr.attr == lock_attr
+            for it in node.items
+        )
+    ]
+    writes = [
+        n.lineno for n in ast.walk(fn) if isinstance(n, ast.Assign)
+        for t in n.targets
+        if isinstance(t, ast.Attribute) and t.attr == attr
+    ]
+    assert writes, f"{cls_name}.{fn_name} no longer writes {attr}"
+    return all(any(s <= w <= e for s, e in spans) for w in writes)
+
+
+def test_service_close_flips_draining_under_state_lock():
+    assert _with_span_covering(
+        "serve/service.py", "QueryService", "close", "draining", "_state_lock"
+    )
+
+
+def test_router_drain_flips_run_under_router_lock():
+    assert _with_span_covering(
+        "serve/router.py", "QueryRouter", "close", "draining", "_lock"
+    )
+    assert _with_span_covering(
+        "serve/router.py", "QueryRouter", "handle_drain", "draining", "_lock"
+    )
+
+
+def test_promotion_store_record_does_io_outside_lock(tmp_path):
+    # the ISSUE-named blocking-under-lock fix: the JSON write happens
+    # after the lock is released, and the record still lands
+    from nds_tpu.engine.aotcache import PromotionStore
+
+    store = PromotionStore(str(tmp_path / "promotions.json"))
+    store.record("k1", {"winner": "pallas", "speedup": 1.4})
+    assert store.get("k1")["winner"] == "pallas"
+    # structurally: no fs call inside the record() lock span
+    fs = [
+        f for f in L.lint_source(
+            open(os.path.join(ROOT, "nds_tpu", "engine", "aotcache.py"),
+                 encoding="utf-8").read(),
+            "engine/aotcache.py",
+        ) if f.rule == "blocking-under-lock"
+    ]
+    assert fs == []
+
+
+def test_catalog_ref_no_duplicate_tableref_under_race(tmp_path):
+    from nds_tpu.lakehouse.catalog import CatalogCoordinator
+
+    coord = CatalogCoordinator.__new__(CatalogCoordinator)
+    coord._lock = threading.Lock()
+    coord._refs = {}
+    seen = []
+    gate = threading.Barrier(4)
+
+    def grab():
+        gate.wait()
+        for _ in range(50):
+            seen.append(coord._ref("/tables/store_sales"))
+
+    threads = [threading.Thread(target=grab) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len({id(r) for r in seen}) == 1, (
+        "racing handlers must resolve one shared _TableRef per path"
+    )
+
+
+def test_session_listener_registration_is_thread_safe():
+    from nds_tpu.engine.session import Session
+
+    s = Session(conf={})
+    try:
+        gate = threading.Barrier(4)
+
+        def add(n):
+            gate.wait()
+            for i in range(50):
+                s.register_listener(lambda reason, n=n, i=i: None)
+
+        threads = [
+            threading.Thread(target=add, args=(n,)) for n in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(s._listeners) == 200, "no lost registrations under races"
+    finally:
+        s.close() if hasattr(s, "close") else None
